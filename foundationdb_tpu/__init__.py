@@ -1,0 +1,32 @@
+"""foundationdb_tpu — a TPU-native distributed transactional key-value framework.
+
+A brand-new framework with the capabilities of FoundationDB (reference:
+dongguaWDY/foundationdb v6.1.0), designed TPU-first:
+
+- Ordered-keyspace, strict-serializable ACID transactions with optimistic MVCC
+  (reference: fdbclient/NativeAPI.actor.cpp, fdbserver/Resolver.actor.cpp).
+- The resolver's conflict detection is a batched interval-overlap engine that
+  checks whole commit batches in one XLA launch against an HBM-resident
+  version-history step function (replaces fdbserver/SkipList.cpp).
+- An unbundled commit pipeline: proxies -> resolvers -> replicated logs ->
+  storage servers (reference: fdbserver/MasterProxyServer.actor.cpp).
+- A fully deterministic single-process cluster simulator with fault injection
+  (reference: fdbrpc/sim2.actor.cpp).
+- Multi-resolver key-space sharding expressed as a jax.sharding.Mesh axis with
+  XLA collectives instead of RPC fan-out.
+
+Subpackages (imported lazily — importing foundationdb_tpu does not pull in jax):
+
+- foundationdb_tpu.utils     keys, errors, knobs, deterministic RNG, tracing
+- foundationdb_tpu.core      futures/promises, deterministic event loop, simulator
+- foundationdb_tpu.ops       device kernels (conflict engine) + CPU oracles
+- foundationdb_tpu.parallel  mesh/sharding: multi-resolver shard_map pipeline
+- foundationdb_tpu.server    roles: proxy, resolver, master, tlog, storage
+- foundationdb_tpu.client    Transaction/Database API with read-your-writes
+- foundationdb_tpu.models    flagship pipeline step used by bench/graft entry
+"""
+
+__version__ = "0.1.0"
+
+# Protocol version, in the spirit of flow/serialize.h currentProtocolVersion.
+PROTOCOL_VERSION = 0x0FDB00B0_71500001

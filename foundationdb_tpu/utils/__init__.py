@@ -1,0 +1,5 @@
+"""Utility layer: key encoding, errors, knobs, deterministic RNG, tracing."""
+
+from foundationdb_tpu.utils.errors import FDBError, error_code  # noqa: F401
+from foundationdb_tpu.utils.knobs import KNOBS, Knobs  # noqa: F401
+from foundationdb_tpu.utils.rng import DeterministicRandom  # noqa: F401

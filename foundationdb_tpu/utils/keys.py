@@ -1,0 +1,81 @@
+"""Key encoding: byte-string keys <-> fixed-width device limbs.
+
+FoundationDB keys are arbitrary byte strings ordered lexicographically
+(fdbclient/FDBTypes.h). A TPU kernel needs fixed shapes, so keys are encoded as
+``NUM_LIMBS`` big-endian uint32 limbs covering the first ``KEY_BYTES`` bytes
+plus one length limb:
+
+    encode(k) = (be32(k[0:4]), be32(k[4:8]), ..., min(len(k), KEY_BYTES))
+
+Lexicographic comparison of the limb tuples equals byte-wise comparison of the
+keys, *exactly* for keys <= KEY_BYTES long. Longer keys collapse onto their
+KEY_BYTES-byte prefix (length clamped), which can only merge distinct keys into
+one — in conflict detection that produces false conflicts (safe, a retry),
+never false commits. This is the fixed-width prefix-binning contract from
+SURVEY.md §7 hard-part 2 (reference tiebreak machinery: SkipList.cpp:147-177).
+
+Ranges are half-open [begin, end) like the reference's KeyRangeRef.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+KEY_BYTES = 24
+NUM_LIMBS = KEY_BYTES // 4 + 1  # 6 data limbs + 1 length limb = 7
+
+
+def encode_key(key: bytes, out: np.ndarray | None = None) -> np.ndarray:
+    """Encode one key to a (NUM_LIMBS,) uint32 vector."""
+    if out is None:
+        out = np.zeros(NUM_LIMBS, dtype=np.uint32)
+    k = key[:KEY_BYTES]
+    padded = k + b"\x00" * (KEY_BYTES - len(k))
+    out[: NUM_LIMBS - 1] = np.frombuffer(padded, dtype=">u4")
+    out[NUM_LIMBS - 1] = min(len(key), KEY_BYTES)
+    return out
+
+
+def encode_keys(keys: list[bytes]) -> np.ndarray:
+    """Encode a list of keys to a (NUM_LIMBS, N) uint32 array (SoA layout)."""
+    n = len(keys)
+    out = np.zeros((NUM_LIMBS, n), dtype=np.uint32)
+    buf = np.zeros(NUM_LIMBS, dtype=np.uint32)
+    for i, k in enumerate(keys):
+        encode_key(k, buf)
+        out[:, i] = buf
+    return out
+
+
+def decode_key(limbs: np.ndarray) -> bytes:
+    """Inverse of encode_key for keys <= KEY_BYTES (used in tests)."""
+    length = int(limbs[NUM_LIMBS - 1])
+    raw = np.asarray(limbs[: NUM_LIMBS - 1], dtype=np.uint32).astype(">u4").tobytes()
+    return raw[:length]
+
+
+# Sentinels: the encoding of b"" (all zeros) is the minimal element; MAX_LIMBS
+# is strictly greater than any real key's encoding (length limb 0xFFFFFFFF).
+MIN_LIMBS = encode_key(b"")
+MAX_LIMBS = np.full(NUM_LIMBS, 0xFFFFFFFF, dtype=np.uint32)
+
+
+def compare_encoded(a: np.ndarray, b: np.ndarray) -> int:
+    """Lexicographic compare of two limb vectors: -1/0/1 (host-side)."""
+    for i in range(NUM_LIMBS):
+        if a[i] != b[i]:
+            return -1 if a[i] < b[i] else 1
+    return 0
+
+
+def strinc(key: bytes) -> bytes:
+    """First key not prefixed by `key` (reference: fdbclient's strinc)."""
+    k = key.rstrip(b"\xff")
+    if not k:
+        raise ValueError("key is all 0xff; no strinc exists")
+    return k[:-1] + bytes([k[-1] + 1])
+
+
+def key_after(key: bytes) -> bytes:
+    """Immediate successor in lexicographic order."""
+    return key + b"\x00"

@@ -1,0 +1,110 @@
+"""Knob (configuration) bank.
+
+Reference: flow/Knobs.cpp + fdbclient/Knobs.cpp + fdbserver/Knobs.cpp — a flat
+registry of named numeric tunables, overridable at startup, where *the config
+system doubles as a fault-injection surface*: under simulation with
+buggification enabled, each knob may be randomly set to an extreme value
+(`flow/Knobs.cpp:36` `init(..); if(randomize && BUGGIFY) ...` pattern).
+
+We keep one bank. `Knobs.buggify(rng)` randomizes knobs that declare extreme
+candidate values, using the deterministic RNG so runs stay replayable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class _Knob:
+    name: str
+    default: Any
+    extremes: tuple = ()  # candidate buggified values
+
+
+@dataclass
+class Knobs:
+    _defs: dict[str, _Knob] = field(default_factory=dict)
+    _values: dict[str, Any] = field(default_factory=dict)
+
+    def init(self, name: str, default: Any, extremes: tuple = ()):
+        self._defs[name] = _Knob(name, default, extremes)
+        self._values[name] = default
+
+    def __getattr__(self, name: str):
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def set(self, name: str, value: Any):
+        if name not in self._defs:
+            raise KeyError(f"unknown knob: {name}")
+        self._values[name] = value
+
+    def reset(self):
+        for k, d in self._defs.items():
+            self._values[k] = d.default
+
+    def buggify(self, rng, probability: float = 0.25):
+        """Randomly set knobs that declare extremes (deterministic under rng)."""
+        for k, d in sorted(self._defs.items()):
+            if d.extremes and rng.random() < probability:
+                self._values[k] = d.extremes[rng.randint(0, len(d.extremes) - 1)]
+
+    def overrides(self, **kw):
+        for k, v in kw.items():
+            self.set(k, v)
+
+
+KNOBS = Knobs()
+
+# --- Versions / MVCC window (fdbserver/Knobs.cpp:30-34) ---
+KNOBS.init("VERSIONS_PER_SECOND", 1_000_000)
+KNOBS.init("MAX_READ_TRANSACTION_LIFE_VERSIONS", 5_000_000, (1_000_000,))
+KNOBS.init("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 5_000_000, (1_000_000,))
+KNOBS.init("MAX_VERSIONS_IN_FLIGHT", 100_000_000)
+
+# --- Commit batching (fdbserver/Knobs.cpp:246-252, MasterProxyServer.actor.cpp:921) ---
+KNOBS.init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, (1, 4))
+KNOBS.init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001, (0.1,))
+KNOBS.init("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.010)
+KNOBS.init("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 100_000)
+
+# --- Conflict engine (device) ---
+KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "oracle" (CPU reference)
+KNOBS.init("CONFLICT_KEY_BYTES", 24)  # exact-comparison key width on device
+KNOBS.init("CONFLICT_STATE_CAPACITY", 1 << 16, (1 << 10,))  # boundary slots
+KNOBS.init("CONFLICT_BATCH_TXNS", 1024)  # static batch shape: txns
+KNOBS.init("CONFLICT_BATCH_READS_PER_TXN", 4)
+KNOBS.init("CONFLICT_BATCH_WRITES_PER_TXN", 4)
+
+# --- Client (fdbclient/Knobs.cpp) ---
+KNOBS.init("MAX_BATCH_SIZE", 20, (1,))  # read-version batcher
+KNOBS.init("GRV_BATCH_INTERVAL", 0.0005, (0.01,))
+KNOBS.init("DEFAULT_BACKOFF", 0.01, (1.0,))
+KNOBS.init("MAX_BACKOFF", 1.0)
+KNOBS.init("KEY_SIZE_LIMIT", 10_000)
+KNOBS.init("VALUE_SIZE_LIMIT", 100_000)
+KNOBS.init("TRANSACTION_SIZE_LIMIT", 10_000_000)
+
+# --- Transport / simulation (flow/Knobs.cpp:51-52, fdbrpc/sim2.actor.cpp) ---
+KNOBS.init("CONNECTION_MONITOR_TIMEOUT", 2.0, (0.1,))
+KNOBS.init("SIM_MIN_LATENCY", 0.0001)
+KNOBS.init("SIM_MAX_LATENCY", 0.002, (0.05,))
+KNOBS.init("SIM_CLOG_PROBABILITY", 0.0)
+KNOBS.init("BUGGIFY_ENABLED", False)
+
+# --- TLog / storage ---
+KNOBS.init("TLOG_QUORUM_ANTIQUORUM", 0)
+KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)
+KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
+
+# --- Ratekeeper (fdbserver/Ratekeeper.actor.cpp) ---
+KNOBS.init("RATEKEEPER_DEFAULT_LIMIT", 1e9)
+KNOBS.init("TARGET_BYTES_PER_STORAGE_SERVER", 1_000_000_000)
+
+# --- Data distribution ---
+KNOBS.init("SHARD_MAX_BYTES", 500_000_000, (10_000,))
+KNOBS.init("SHARD_MIN_BYTES", 200_000, (1_000,))

@@ -1,0 +1,82 @@
+"""Structured trace events + counters.
+
+Reference: flow/Trace.cpp (`TraceEvent("Type", id).detail(k, v)` structured
+logging with severities and rolling files) and flow/Stats.h (Counter /
+CounterCollection periodically dumped into the trace log).
+
+We log JSON lines. The global sink is swappable so the simulator can timestamp
+events with virtual time and tests can capture them.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from collections import defaultdict
+from typing import Callable
+
+SevDebug, SevInfo, SevWarn, SevWarnAlways, SevError = 5, 10, 20, 30, 40
+
+_now: Callable[[], float] = time.time
+_sink: Callable[[dict], None] | None = None
+_min_severity = SevInfo
+
+
+def set_clock(fn: Callable[[], float]):
+    global _now
+    _now = fn
+
+
+def set_sink(fn: Callable[[dict], None] | None):
+    global _sink
+    _sink = fn
+
+
+def set_min_severity(sev: int):
+    global _min_severity
+    _min_severity = sev
+
+
+class TraceEvent:
+    __slots__ = ("_fields", "_sev")
+
+    def __init__(self, event_type: str, ident=None, severity: int = SevInfo):
+        self._sev = severity
+        self._fields = {"Type": event_type, "Time": round(_now(), 6)}
+        if ident is not None:
+            self._fields["ID"] = str(ident)
+
+    def detail(self, key: str, value) -> "TraceEvent":
+        self._fields[key] = value
+        return self
+
+    def error(self, e: BaseException) -> "TraceEvent":
+        self._sev = max(self._sev, SevError)
+        self._fields["Error"] = repr(e)
+        return self
+
+    def log(self):
+        if self._sev < _min_severity:
+            return
+        if _sink is not None:
+            _sink(self._fields)
+        else:
+            print(json.dumps(self._fields, default=str), file=sys.stderr)
+
+
+class CounterCollection:
+    """Named monotonic counters per role (flow/Stats.h:57)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counters: dict[str, float] = defaultdict(float)
+
+    def add(self, key: str, n: float = 1.0):
+        self.counters[key] += n
+
+    def trace(self):
+        ev = TraceEvent(f"{self.name}Metrics")
+        for k, v in sorted(self.counters.items()):
+            ev.detail(k, v)
+        ev.log()

@@ -1,0 +1,78 @@
+"""Unit tests for the utility layer (keys, errors, knobs, rng)."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.utils import keys as K
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+def test_key_encoding_roundtrip():
+    for k in [b"", b"a", b"abc", b"\x00", b"\xff" * 10, b"x" * 24]:
+        assert K.decode_key(K.encode_key(k)) == k
+
+
+def test_key_encoding_order_matches_bytes_order():
+    rng = DeterministicRandom(1)
+    ks = [rng.random_bytes(rng.randint(0, 24)) for _ in range(300)]
+    ks += [b"abc", b"abc\x00", b"abd", b"ab", b"", b"\xff" * 24]
+    enc = [K.encode_key(k) for k in ks]
+    for i in range(len(ks)):
+        for j in range(i + 1, len(ks)):
+            want = (ks[i] > ks[j]) - (ks[i] < ks[j])
+            got = K.compare_encoded(enc[i], enc[j])
+            assert got == want, (ks[i], ks[j])
+
+
+def test_key_truncation_is_prefix_collapse():
+    long1 = b"p" * 24 + b"a"
+    long2 = b"p" * 24 + b"b"
+    assert K.compare_encoded(K.encode_key(long1), K.encode_key(long2)) == 0
+    assert K.compare_encoded(K.encode_key(b"p" * 24), K.encode_key(long1)) == 0
+
+
+def test_max_sentinel_greater_than_all():
+    for k in [b"", b"\xff" * 24, b"\xff" * 100]:
+        assert K.compare_encoded(K.encode_key(k), K.MAX_LIMBS) == -1
+
+
+def test_encode_keys_batch():
+    ks = [b"a", b"bb", b"ccc"]
+    arr = K.encode_keys(ks)
+    assert arr.shape == (K.NUM_LIMBS, 3)
+    for i, k in enumerate(ks):
+        assert K.decode_key(arr[:, i]) == k
+
+
+def test_strinc_and_key_after():
+    assert K.strinc(b"a") == b"b"
+    assert K.strinc(b"a\xff\xff") == b"b"
+    assert K.key_after(b"a") == b"a\x00"
+    with pytest.raises(ValueError):
+        K.strinc(b"\xff")
+
+
+def test_errors():
+    e = FDBError("not_committed")
+    assert e.code == 1020 and e.is_retryable
+    e2 = FDBError("io_error")
+    assert not e2.is_retryable
+    with pytest.raises(ValueError):
+        FDBError("no_such_error")
+
+
+def test_knobs_buggify_deterministic():
+    r1, r2 = DeterministicRandom(7), DeterministicRandom(7)
+    KNOBS.buggify(r1)
+    snap1 = dict(KNOBS._values)
+    KNOBS.reset()
+    KNOBS.buggify(r2)
+    assert dict(KNOBS._values) == snap1
+
+
+def test_rng_determinism():
+    a, b = DeterministicRandom(42), DeterministicRandom(42)
+    assert [a.randint(0, 100) for _ in range(10)] == [b.randint(0, 100) for _ in range(10)]
+    assert a.fork().random() == b.fork().random()
