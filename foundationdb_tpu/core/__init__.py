@@ -1,0 +1,22 @@
+"""Deterministic runtime core (the Flow-runtime equivalent).
+
+Reference layer 0+1: flow/flow.h (Future/Promise/actors), flow/Net2.actor.cpp
+(single-threaded prioritized event loop), fdbrpc/sim2.actor.cpp (deterministic
+simulator: virtual clock, simulated network with latency/clog/partition,
+kill/reboot, non-durable files).
+
+The host control plane is Python coroutines over a custom deterministic
+scheduler — the analogue of the ACTOR compiler is plain async/await; the
+analogue of swapping g_network for Sim2 is constructing an EventLoop with a
+virtual clock and a SimNetwork.
+"""
+
+from foundationdb_tpu.core.future import (  # noqa: F401
+    Future,
+    Promise,
+    PromiseStream,
+    all_of,
+    any_of,
+)
+from foundationdb_tpu.core.eventloop import EventLoop, TaskPriority  # noqa: F401
+from foundationdb_tpu.core.sim import SimNetwork, KillType  # noqa: F401

@@ -1,0 +1,196 @@
+"""Deterministic single-threaded prioritized event loop.
+
+Reference: flow/Net2.actor.cpp — Net2::run (:550) drains a priority queue of
+OrderedTasks with 42 named priorities (flow/network.h:31-73); the simulator
+(fdbrpc/sim2.actor.cpp) replaces the wall clock with virtual time so a run is a
+pure function of the seed.
+
+Ordering contract: runnable items execute in (time, -priority, seq) order.
+`seq` is a global monotone counter, so same-time same-priority items run in
+schedule order — this is what makes whole-cluster simulation replayable.
+
+The loop runs coroutines ("actors") that await Futures. Cancellation follows
+Flow's model: cancelling an actor injects operation_cancelled at its current
+wait point (flow/README.md "ACTOR cancellation").
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Coroutine
+
+from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.utils.errors import FDBError
+
+
+class TaskPriority:
+    """Subset of flow/network.h task priorities (higher runs first)."""
+
+    Max = 1000000
+    Coordination = 8800
+    FailureMonitor = 8700
+    TLogCommit = 8570
+    ProxyCommitDispatch = 8550
+    ProxyCommit = 8540
+    ResolverResolve = 8530
+    ProxyGetConsistentReadVersion = 8500
+    DefaultOnMainThread = 7500
+    DefaultDelay = 7010
+    DefaultYield = 7000
+    DataDistribution = 3500
+    UpdateStorage = 3000
+    Low = 2000
+    Min = 1000
+    Zero = 0
+
+
+class ActorTask(Future):
+    """A running coroutine; also the Future of its final result."""
+
+    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled")
+
+    def __init__(self, loop: "EventLoop", coro: Coroutine, name: str):
+        super().__init__()
+        self._loop = loop
+        self._coro = coro
+        self.name = name
+        self._waiting_on: Future | None = None
+        self._cancelled = False
+
+    def cancel(self):
+        """Inject operation_cancelled at the actor's current wait point."""
+        if self.is_ready() or self._cancelled:
+            return
+        self._cancelled = True
+        if self._waiting_on is not None:
+            self._waiting_on.remove_callback(self._on_waited)
+            self._waiting_on = None
+        self._loop._schedule(0.0, TaskPriority.DefaultOnMainThread, self._step_cancel)
+
+    def _step_cancel(self):
+        if self.is_ready():
+            return
+        try:
+            self._coro.throw(FDBError("operation_cancelled"))
+        except StopIteration as stop:
+            self._set(stop.value)
+            return
+        except FDBError as e:
+            self._set_error(e)
+            return
+        except BaseException as e:  # noqa: BLE001
+            self._set_error(e)
+            return
+        # Actor swallowed the cancellation and kept waiting: let it finish.
+        self._cancelled = False
+        self._after_step()
+
+    def _start(self):
+        self._step()
+
+    def _step(self):
+        try:
+            waited = self._coro.send(None)
+        except StopIteration as stop:
+            self._set(stop.value)
+            return
+        except BaseException as e:  # noqa: BLE001
+            self._set_error(e)
+            return
+        self._waiting_on = waited
+        waited.add_callback(self._on_waited)
+
+    def _after_step(self):
+        # resume stepping after a swallowed cancel: the coroutine yielded again
+        # inside its except handler, or returned — both handled by re-driving.
+        if self._waiting_on is not None and self._waiting_on.is_ready():
+            self._on_waited(self._waiting_on)
+
+    def _on_waited(self, fut: Future):
+        self._waiting_on = None
+        self._loop._schedule(0.0, TaskPriority.DefaultOnMainThread, self._step)
+
+
+class EventLoop:
+    """Deterministic scheduler with a virtual (or wall) clock."""
+
+    def __init__(self, start_time: float = 0.0):
+        self._now = start_time
+        self._seq = 0
+        self._heap: list[tuple[float, int, int, Any]] = []
+        self._stopped = False
+
+    # -- clock --
+    def now(self) -> float:
+        return self._now
+
+    # -- scheduling primitives --
+    def _schedule(self, delay: float, priority: int, fn):
+        self._seq += 1
+        heapq.heappush(self._heap, (self._now + delay, -priority, self._seq, fn))
+
+    def delay(self, seconds: float, priority: int = TaskPriority.DefaultDelay) -> Future:
+        f = Future()
+        self._schedule(max(0.0, seconds), priority, lambda: f._set(None) if not f.is_ready() else None)
+        return f
+
+    def yield_(self, priority: int = TaskPriority.DefaultYield) -> Future:
+        return self.delay(0.0, priority)
+
+    def spawn(self, coro: Coroutine, name: str = "actor") -> ActorTask:
+        task = ActorTask(self, coro, name)
+        self._schedule(0.0, TaskPriority.DefaultOnMainThread, task._start)
+        return task
+
+    def stop(self):
+        self._stopped = True
+
+    # -- running --
+    def run_until_idle(self, max_time: float | None = None) -> float:
+        """Drain the queue, advancing virtual time; returns final time."""
+        self._stopped = False
+        while self._heap and not self._stopped:
+            t, negp, seq, fn = heapq.heappop(self._heap)
+            if max_time is not None and t > max_time:
+                heapq.heappush(self._heap, (t, negp, seq, fn))
+                self._now = max_time
+                break
+            self._now = max(self._now, t)
+            fn()
+        return self._now
+
+    def run_future(self, fut: Future, max_time: float | None = None) -> Any:
+        """Run until `fut` resolves; returns its value (or raises)."""
+        self._stopped = False
+        while not fut.is_ready() and self._heap and not self._stopped:
+            t, _negp, _seq, fn = heapq.heappop(self._heap)
+            if max_time is not None and t > max_time:
+                raise FDBError("timed_out", "run_future hit max_time")
+            self._now = max(self._now, t)
+            fn()
+        if not fut.is_ready():
+            raise FDBError("internal_error", "deadlock: future unresolved and queue empty")
+        return fut.get()
+
+    def timeout(self, fut: Future, seconds: float) -> Future:
+        """Future of fut's value, or error timed_out after `seconds`.
+
+        Reference: flow/genericactors.actor.h timeoutError.
+        """
+        out = Future()
+
+        def on_fut(f: Future):
+            if out.is_ready():
+                return
+            if f.is_error():
+                out._set_error(f._result)
+            else:
+                out._set(f._result)
+
+        fut.add_callback(on_fut)
+        self._schedule(
+            seconds,
+            TaskPriority.DefaultDelay,
+            lambda: out._set_error(FDBError("timed_out")) if not out.is_ready() else None,
+        )
+        return out

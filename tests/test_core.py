@@ -1,0 +1,229 @@
+"""Tests for the deterministic runtime core (futures, loop, simulator)."""
+
+import pytest
+
+from foundationdb_tpu.core.eventloop import EventLoop, TaskPriority
+from foundationdb_tpu.core.future import Future, Promise, PromiseStream, all_of, any_of
+from foundationdb_tpu.core.sim import Endpoint, KillType, SimNetwork
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+def test_future_basic():
+    p = Promise()
+    assert not p.future.is_ready()
+    p.send(42)
+    assert p.future.get() == 42
+
+
+def test_future_error():
+    p = Promise()
+    p.send_error(FDBError("not_committed"))
+    with pytest.raises(FDBError):
+        p.future.get()
+
+
+def test_broken_promise():
+    p = Promise()
+    p.break_promise()
+    assert p.future.is_error()
+
+
+def test_actor_await_and_delay():
+    loop = EventLoop()
+    log = []
+
+    async def actor():
+        log.append(("start", loop.now()))
+        await loop.delay(1.5)
+        log.append(("after", loop.now()))
+        return "done"
+
+    t = loop.spawn(actor())
+    assert loop.run_future(t) == "done"
+    assert log == [("start", 0.0), ("after", 1.5)]
+
+
+def test_virtual_time_ordering_and_priority():
+    loop = EventLoop()
+    order = []
+    loop._schedule(1.0, TaskPriority.Low, lambda: order.append("low"))
+    loop._schedule(1.0, TaskPriority.TLogCommit, lambda: order.append("high"))
+    loop._schedule(0.5, TaskPriority.Low, lambda: order.append("early"))
+    loop.run_until_idle()
+    assert order == ["early", "high", "low"]
+
+
+def test_actor_cancellation():
+    loop = EventLoop()
+    witness = []
+
+    async def actor():
+        try:
+            await loop.delay(100.0)
+        except FDBError as e:
+            witness.append(e.name)
+            raise
+
+    t = loop.spawn(actor())
+    loop._schedule(1.0, TaskPriority.DefaultDelay, t.cancel)
+    with pytest.raises(FDBError):
+        loop.run_future(t)
+    assert witness == ["operation_cancelled"]
+
+
+def test_promise_stream():
+    loop = EventLoop()
+    stream = PromiseStream()
+    got = []
+
+    async def consumer():
+        for _ in range(3):
+            got.append(await stream.pop())
+
+    t = loop.spawn(consumer())
+    stream.send(1)
+    stream.send(2)
+    loop._schedule(0.5, TaskPriority.DefaultDelay, lambda: stream.send(3))
+    loop.run_future(t)
+    assert got == [1, 2, 3]
+
+
+def test_all_of_any_of():
+    p1, p2 = Promise(), Promise()
+    a = all_of([p1.future, p2.future])
+    n = any_of([p1.future, p2.future])
+    p2.send("b")
+    assert n.get() == (1, "b")
+    assert not a.is_ready()
+    p1.send("a")
+    assert a.get() == ["a", "b"]
+
+
+def test_timeout():
+    loop = EventLoop()
+    p = Promise()
+    f = loop.timeout(p.future, 2.0)
+    loop.run_until_idle()
+    assert f.is_error()
+    with pytest.raises(FDBError, match="timed_out"):
+        f.get()
+
+
+def _mk_net(seed=1):
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(seed))
+    return loop, net
+
+
+def test_sim_rpc_roundtrip():
+    loop, net = _mk_net()
+    server = net.new_process("server:1")
+    client = net.new_process("client:1")
+    server.register(100, lambda payload, reply: reply.send(payload * 2))
+
+    async def run():
+        return await net.request(client, Endpoint("server:1", 100), 21)
+
+    t = client.spawn(run())
+    assert loop.run_future(t) == 42
+    assert loop.now() > 0.0  # latency was applied
+
+
+def test_sim_rpc_to_dead_process_is_broken_promise():
+    loop, net = _mk_net()
+    server = net.new_process("server:1")
+    client = net.new_process("client:1")
+    server.register(100, lambda payload, reply: reply.send(1))
+    net.kill("server:1")
+
+    async def run():
+        await net.request(client, Endpoint("server:1", 100), None)
+
+    t = client.spawn(run())
+    with pytest.raises(FDBError, match="broken_promise"):
+        loop.run_future(t)
+
+
+def test_sim_kill_mid_request_breaks_promise():
+    loop, net = _mk_net()
+    server = net.new_process("server:1")
+    client = net.new_process("client:1")
+    # Handler never replies; the kill must break the owed promise.
+    server.register(100, lambda payload, reply: None)
+
+    async def run():
+        await net.request(client, Endpoint("server:1", 100), None)
+
+    t = client.spawn(run())
+    loop._schedule(1.0, TaskPriority.DefaultDelay, lambda: net.kill("server:1"))
+    with pytest.raises(FDBError, match="broken_promise"):
+        loop.run_future(t)
+
+
+def test_sim_partition_drops_packets():
+    loop, net = _mk_net()
+    server = net.new_process("server:1")
+    client = net.new_process("client:1")
+    server.register(100, lambda payload, reply: reply.send(1))
+    net.partition("client:1", "server:1")
+
+    async def run():
+        return await loop.timeout(net.request(client, Endpoint("server:1", 100), None), 5.0)
+
+    t = client.spawn(run())
+    with pytest.raises(FDBError, match="timed_out"):
+        loop.run_future(t)
+    net.heal()
+
+
+def test_sim_reboot_runs_boot_fn_and_kills_actors():
+    loop, net = _mk_net()
+    p = net.new_process("server:1")
+    boots = []
+    p.boot_fn = lambda proc: boots.append(loop.now())
+
+    async def forever():
+        await loop.delay(1e9)
+
+    p.spawn(forever())
+    net.kill("server:1", KillType.RebootProcess)
+    loop.run_until_idle(max_time=10.0)
+    assert p.alive and p.reboots == 1 and len(boots) == 1
+
+
+def test_sim_file_loses_unsynced_writes_on_kill():
+    loop, net = _mk_net(seed=3)
+    p = net.new_process("server:1")
+    f = net.open_file(p, "wal")
+    f.append(b"a")
+    f.sync()
+    f.append(b"b")
+    f.append(b"c")
+    net.kill("server:1", KillType.RebootProcess)
+    loop.run_until_idle(max_time=10.0)
+    data = f.durable
+    # synced prefix always survives; unsynced tail is a prefix of b"bc"
+    assert data.startswith(b"a")
+    assert data in (b"a", b"ab", b"abc")
+
+
+def test_determinism_same_seed_same_trace():
+    def run(seed):
+        loop, net = _mk_net(seed)
+        server = net.new_process("server:1")
+        client = net.new_process("client:1")
+        server.register(7, lambda x, r: r.send(x + 1))
+        results = []
+
+        async def driver():
+            for i in range(20):
+                v = await net.request(client, Endpoint("server:1", 7), i)
+                results.append((round(loop.now(), 9), v))
+
+        t = client.spawn(driver())
+        loop.run_future(t)
+        return results
+
+    assert run(5) == run(5)
+    assert run(5) != run(6)  # latency schedule differs by seed
