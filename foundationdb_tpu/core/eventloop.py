@@ -70,27 +70,21 @@ class ActorTask(Future):
     def _step_cancel(self):
         if self.is_ready():
             return
-        try:
-            self._coro.throw(FDBError("operation_cancelled"))
-        except StopIteration as stop:
-            self._set(stop.value)
-            return
-        except FDBError as e:
-            self._set_error(e)
-            return
-        except BaseException as e:  # noqa: BLE001
-            self._set_error(e)
-            return
-        # Actor swallowed the cancellation and kept waiting: let it finish.
+        # If the actor swallows the cancellation (cleanup in an except/finally
+        # that awaits), _drive registers on whatever it awaits next.
         self._cancelled = False
-        self._after_step()
+        self._drive(lambda: self._coro.throw(FDBError("operation_cancelled")))
 
     def _start(self):
         self._step()
 
     def _step(self):
+        self._drive(lambda: self._coro.send(None))
+
+    def _drive(self, advance):
+        """Advance the coroutine one step; park it on whatever it yields."""
         try:
-            waited = self._coro.send(None)
+            waited = advance()
         except StopIteration as stop:
             self._set(stop.value)
             return
@@ -99,12 +93,6 @@ class ActorTask(Future):
             return
         self._waiting_on = waited
         waited.add_callback(self._on_waited)
-
-    def _after_step(self):
-        # resume stepping after a swallowed cancel: the coroutine yielded again
-        # inside its except handler, or returned — both handled by re-driving.
-        if self._waiting_on is not None and self._waiting_on.is_ready():
-            self._on_waited(self._waiting_on)
 
     def _on_waited(self, fut: Future):
         self._waiting_on = None
@@ -163,8 +151,9 @@ class EventLoop:
         """Run until `fut` resolves; returns its value (or raises)."""
         self._stopped = False
         while not fut.is_ready() and self._heap and not self._stopped:
-            t, _negp, _seq, fn = heapq.heappop(self._heap)
+            t, negp, seq, fn = heapq.heappop(self._heap)
             if max_time is not None and t > max_time:
+                heapq.heappush(self._heap, (t, negp, seq, fn))  # don't lose it
                 raise FDBError("timed_out", "run_future hit max_time")
             self._now = max(self._now, t)
             fn()

@@ -61,6 +61,10 @@ class SimProcess:
     def spawn(self, coro, name: str = "actor") -> ActorTask:
         task = self.net.loop.spawn(coro, name=f"{self.address}/{name}")
         self.actors.append(task)
+        # completed actors drop out of the kill list (long-lived processes
+        # spawn one actor per request; keeping them all would leak)
+        task.add_callback(lambda _f: self.actors.remove(task)
+                          if task in self.actors else None)
         return task
 
     # -- endpoint registration (RequestStream server side) --

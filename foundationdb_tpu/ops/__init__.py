@@ -1,0 +1,14 @@
+"""Device kernels and their CPU oracles.
+
+The centerpiece is the conflict engine (SURVEY.md §3.2 north star): the
+reference's SkipList-based ConflictSet (fdbserver/SkipList.cpp) re-designed as
+a batched interval-overlap kernel over an HBM-resident version-history step
+function, one XLA launch per commit batch.
+"""
+
+from foundationdb_tpu.ops.batch import (  # noqa: F401
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    TxnConflictInfo,
+)

@@ -1,0 +1,31 @@
+"""Shared batch types for the conflict engine.
+
+Reference interface: fdbserver/ConflictSet.h:27-44 — ConflictBatch collects
+transactions (read snapshot + read/write conflict ranges), detectConflicts
+returns a per-transaction result in {TransactionConflict, TransactionTooOld,
+TransactionCommitted} (:36-40). We keep the reference's result numbering so
+logs/tests line up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# ConflictSet.h:36-40 TransactionConflictStatus
+CONFLICT = 0
+TOO_OLD = 1
+COMMITTED = 2
+
+STATUS_NAMES = {CONFLICT: "Conflict", TOO_OLD: "TooOld", COMMITTED: "Committed"}
+
+
+@dataclass
+class TxnConflictInfo:
+    """One transaction's conflict information (CommitTransaction.h:89-101).
+
+    Ranges are half-open [begin, end) byte-string pairs.
+    """
+
+    read_snapshot: int
+    read_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    write_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)

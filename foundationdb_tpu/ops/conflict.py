@@ -1,0 +1,505 @@
+"""The TPU conflict engine: batched MVCC conflict detection in one XLA launch.
+
+This replaces fdbserver/SkipList.cpp (the reference's hand-tuned CPU conflict
+engine, SURVEY.md §3.2) with a TPU-first design. The reference hides memory
+latency with 16 interleaved skiplist cursors (SkipList.cpp:526-552) and a
+hierarchical bitmask (:1028-1130); we instead make the whole batch a dense
+tensor program:
+
+State = the *max-commit-version step function* over the keyspace, stored as
+device-resident sorted boundary keys (fixed-width uint32 limbs) + per-segment
+version offsets + a sparse-table (power-of-two window) max pyramid — the dense
+analogue of the skiplist's per-level max-version annotations (:324-357).
+
+detect = ONE jitted function:
+  1. too-old filter (SkipList.cpp:985 semantics)
+  2. history check: vectorized binary search of every read range's endpoints
+     over the boundary array + O(1) sparse-table range-max, compare against
+     each txn's read snapshot (replaces CheckMax :755-837)
+  3. intra-batch: endpoint ranking by one lax.sort, pairwise read/write
+     overlap, txn-level dependency matrix, and an exact
+     lower/upper-bound fixpoint for "earlier txns win" semantics (replaces
+     MiniConflictSet :1028-1130; converges in <= chain-depth iterations,
+     each a tiny boolean mat-vec)
+  4. merge of surviving writes into the step function by sort/dedupe/coverage
+     prefix-sums (replaces mergeWriteConflictRanges :1260-1318)
+  5. window GC by clamp + coalesce (replaces removeBefore :665)
+
+Versions on device are int32 *offsets* from a host-kept int64 base (the MVCC
+window is only 5e6 versions wide — fdbserver/Knobs.cpp:30-34 — so offsets fit
+comfortably; the host rebases long before overflow). This keeps the kernel in
+TPU-native 32-bit arithmetic.
+
+Keys are exact up to KEY_BYTES (24) bytes; longer keys collapse to their
+prefix, which can only create false conflicts (safe), never false commits
+(utils/keys.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from foundationdb_tpu.ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnConflictInfo
+from foundationdb_tpu.utils import keys as keylib
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+
+L = keylib.NUM_LIMBS  # key limbs (6 data + 1 length)
+NEG = jnp.int32(-(1 << 30))  # "no version" sentinel, below any clamped offset
+_REBASE_THRESHOLD = 1 << 29
+
+
+# ---------------------------------------------------------------------------
+# multi-limb key comparisons (vectorized lexicographic)
+# ---------------------------------------------------------------------------
+
+def _key_lt(a, b):
+    """a < b lexicographically; a, b are (L, ...) uint32."""
+    lt = jnp.zeros(a.shape[1:], dtype=bool)
+    eq = jnp.ones(a.shape[1:], dtype=bool)
+    for i in range(L):
+        lt = lt | (eq & (a[i] < b[i]))
+        eq = eq & (a[i] == b[i])
+    return lt
+
+
+def _key_eq(a, b):
+    eq = jnp.ones(a.shape[1:], dtype=bool)
+    for i in range(L):
+        eq = eq & (a[i] == b[i])
+    return eq
+
+
+def _searchsorted(bkeys, queries, side: str):
+    """Vectorized binary search over sorted multi-limb keys.
+
+    bkeys: (L, K) sorted ascending; queries: (L, Q).
+    side='left'  -> first index i with bkeys[:,i] >= q (lower bound)
+    side='right' -> first index i with bkeys[:,i] >  q (upper bound)
+    """
+    K = bkeys.shape[1]
+    Q = queries.shape[1]
+    lo = jnp.zeros(Q, dtype=jnp.int32)
+    hi = jnp.full(Q, K, dtype=jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(max(K, 2)))) + 1)
+
+    def body(_i, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        midkeys = bkeys[:, mid]  # (L, Q) gather
+        if side == "left":
+            go_right = _key_lt(midkeys, queries)
+        else:
+            go_right = ~_key_lt(queries, midkeys)  # midkeys <= q
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# sparse table (range-max in O(1) per query)
+# ---------------------------------------------------------------------------
+
+def _build_table(vals):
+    """vals: (K,) int32 -> (LEVELS, K) power-of-two window maxima.
+
+    table[l, i] = max(vals[i : i + 2**l]) (clipped at K). The dense analogue
+    of the skiplist's level max-version pyramid (SkipList.cpp:324-357).
+    """
+    K = vals.shape[0]
+    levels = max(1, int(np.ceil(np.log2(max(K, 2)))) + 1)
+    rows = [vals]
+    cur = vals
+    for l in range(1, levels):
+        shift = 1 << (l - 1)
+        shifted = jnp.concatenate([cur[shift:], jnp.full(min(shift, K), NEG, cur.dtype)])[:K]
+        cur = jnp.maximum(cur, shifted)
+        rows.append(cur)
+    return jnp.stack(rows)
+
+
+def _range_max(table, i0, i1):
+    """Max over vals[i0:i1) for vectors i0 < i1 (int32 arrays)."""
+    w = jnp.maximum(i1 - i0, 1)
+    lvl = 31 - lax.clz(w)  # floor(log2(w))
+    left = table[lvl, i0]
+    right = table[lvl, jnp.maximum(i1 - (1 << lvl).astype(jnp.int32), i0)]
+    return jnp.maximum(left, right)
+
+
+# ---------------------------------------------------------------------------
+# the jitted step
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ConflictShapes:
+    """Static shapes of one conflict batch (one XLA program per instance)."""
+
+    capacity: int  # K: boundary slots in the step function
+    txns: int  # T
+    reads: int  # NR: total read ranges per batch (flattened)
+    writes: int  # NW: total write ranges per batch
+
+
+def _f_commit(g, dep, c):
+    """One application of the batch-order commit operator.
+
+    f(c)[t] = g[t] and no earlier txn t1 with dep[t1,t] is in c.
+    """
+    blocked = jnp.any(dep & c[:, None], axis=0)
+    return g & ~blocked
+
+
+def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
+                  max_write_life: int):
+    """Pure function: (state, batch) -> (state', statuses, info). Jit-able.
+
+    state:
+      bkeys (L,K) uint32 sorted; bval (K,) i32; nb () i32; oldest () i32;
+      table (LEVELS,K) i32
+    batch:
+      txn_valid (T,) bool; snapshot (T,) i32 (version offsets)
+      rb, re (L,NR) u32; rtxn (NR,) i32 (= T for padding);
+      wb, we (L,NW) u32; wtxn (NW,) i32 (= T for padding)
+      commit_version () i32 offset
+      advance_floor () bool — advance the MVCC window after this chunk
+      (False for all but the last chunk of a logical batch)
+    """
+    T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
+    bkeys, bval, nb, oldest, table = (
+        state["bkeys"], state["bval"], state["nb"], state["oldest"], state["table"])
+    rb, re, rtxn = batch["rb"], batch["re"], batch["rtxn"]
+    wb, we, wtxn = batch["wb"], batch["we"], batch["wtxn"]
+    snapshot, txn_valid = batch["snapshot"], batch["txn_valid"]
+    vnew = batch["commit_version"]
+
+    rvalid = rtxn < T
+    wvalid = wtxn < T
+    has_reads = (jnp.zeros(T + 1, bool).at[rtxn].max(rvalid))[:T]
+
+    # ---- 1. too-old (only txns with read ranges expire: SkipList.cpp:985) ----
+    too_old = txn_valid & has_reads & (snapshot < oldest)
+
+    # ---- 2. history check: range-max of step function vs snapshot ----
+    i0 = _searchsorted(bkeys, rb, "right") - 1  # segment containing begin
+    i1 = _searchsorted(bkeys, re, "left")  # first boundary >= end
+    i0 = jnp.maximum(i0, 0)
+    nonempty = _key_lt(rb, re)
+    maxver = _range_max(table, i0, jnp.maximum(i1, i0 + 1))
+    rsnap = snapshot[jnp.minimum(rtxn, T - 1)]
+    read_hits = rvalid & nonempty & (maxver > rsnap)
+    hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
+
+    # ---- 3. intra-batch: endpoint ranks -> pairwise overlap -> fixpoint ----
+    allk = jnp.concatenate([rb, re, wb, we], axis=1)  # (L, NA)
+    NA = 2 * NR + 2 * NW
+    ops = [allk[i] for i in range(L)] + [jnp.arange(NA, dtype=jnp.int32)]
+    sorted_ops = lax.sort(ops, num_keys=L)
+    perm = sorted_ops[L]
+    skeys = jnp.stack(sorted_ops[:L])
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])])
+    rank_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+    ranks = jnp.zeros(NA, jnp.int32).at[perm].set(rank_sorted)
+    rbr, rer = ranks[:NR], ranks[NR:2 * NR]
+    wbr, wer = ranks[2 * NR:2 * NR + NW], ranks[2 * NR + NW:]
+
+    # empty/inverted ranges (end <= begin) participate in neither side
+    r_nonempty = rbr < rer
+    w_nonempty = wbr < wer
+    overlap = ((wbr[:, None] < rer[None, :]) & (rbr[None, :] < wer[:, None])
+               & (wvalid & w_nonempty)[:, None] & (rvalid & r_nonempty)[None, :])  # (NW, NR)
+    # dep[t1, t2]: t1's writes overlap t2's reads (scatter-max; padding -> slot T)
+    dep = jnp.zeros((T + 1, T + 1), bool)
+    dep = dep.at[wtxn[:, None], rtxn[None, :]].max(overlap)
+    dep = dep[:T, :T]
+    tri = jnp.arange(T)[:, None] < jnp.arange(T)[None, :]  # strict t1 < t2
+    dep = dep & tri
+
+    g = txn_valid & ~too_old & ~hist_conflict
+    upper = g
+    lower = _f_commit(g, dep, upper)
+
+    def cond(lu):
+        lower, upper = lu
+        return jnp.any(lower != upper)
+
+    def body(lu):
+        lower, upper = lu
+        upper2 = _f_commit(g, dep, lower)
+        lower2 = _f_commit(g, dep, upper2)
+        return lower2, upper2
+
+    lower, upper = lax.while_loop(cond, body, (lower, upper))
+    commit = lower
+
+    statuses = jnp.where(
+        commit, COMMITTED,
+        jnp.where(too_old, TOO_OLD, CONFLICT)).astype(jnp.int32)
+    statuses = jnp.where(txn_valid, statuses, COMMITTED)
+
+    # ---- 4. merge surviving writes into the step function at vnew ----
+    # committed, non-empty writes only: an inverted range would inject a
+    # reversed -1/+1 coverage delta and cancel other writes' coverage
+    cw = wvalid & commit[jnp.minimum(wtxn, T - 1)] & _key_lt(wb, we)
+    cand = jnp.concatenate([wb, we], axis=1)  # (L, 2NW) candidate boundaries
+    cand_valid = jnp.concatenate([cw, cw])
+    # value at each candidate key under the current function
+    ci = jnp.maximum(_searchsorted(bkeys, cand, "right") - 1, 0)
+    cand_val = bval[ci]
+    # delta for coverage counting: +1 at committed write begins, -1 at ends
+    cand_delta = jnp.concatenate(
+        [cw.astype(jnp.int32), -(cw.astype(jnp.int32))])
+    # neutralize invalid candidates
+    maxk = jnp.full((L, 1), jnp.uint32(0xFFFFFFFF))
+    cand = jnp.where(cand_valid[None, :], cand, maxk)
+    cand_val = jnp.where(cand_valid, cand_val, NEG)
+
+    slot_valid = jnp.arange(K) < nb
+    allkeys = jnp.concatenate([jnp.where(slot_valid[None, :], bkeys, maxk), cand], axis=1)
+    allvals = jnp.concatenate([jnp.where(slot_valid, bval, NEG), cand_val])
+    alldelta = jnp.concatenate([jnp.zeros(K, jnp.int32), cand_delta])
+    KA = K + 2 * NW
+
+    ops = [allkeys[i] for i in range(L)] + [allvals, alldelta]
+    s = lax.sort(ops, num_keys=L)
+    skeys = jnp.stack(s[:L])
+    svals, sdelta = s[L], s[L + 1]
+    live = ~_key_eq(skeys, jnp.broadcast_to(maxk, skeys.shape))
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])]) & live
+    grp = jnp.cumsum(first.astype(jnp.int32)) - 1  # key rank (garbage on dead)
+    grp = jnp.where(live, grp, KA - 1)
+    # per-rank coverage: sum deltas per key, then prefix over ranks
+    grp_delta = jnp.zeros(KA, jnp.int32).at[grp].add(jnp.where(live, sdelta, 0))
+    cover = jnp.cumsum(grp_delta)  # cover[r] > 0 => keys of rank r covered
+    # entries of the same key share the same value; keep group-first entries
+    gval = jnp.zeros(KA, jnp.int32).at[grp].max(jnp.where(live, svals, NEG))
+    covered = cover[grp] > 0
+    newval = jnp.where(covered, jnp.maximum(gval[grp], vnew), gval[grp])
+
+    # ---- 5. window GC: clamp to new floor + coalesce equal neighbors ----
+    # advance_floor is False for all but the last chunk of a logical batch:
+    # the too-old check and history clamping must use the PRE-batch floor for
+    # every transaction of the batch (the reference advances oldestVersion
+    # once per detectConflicts call, SkipList.cpp:1199-1206).
+    floor = jnp.where(batch["advance_floor"],
+                      vnew - jnp.int32(max_write_life), oldest)
+    new_oldest = jnp.maximum(oldest, floor)
+    newval = jnp.maximum(newval, new_oldest)
+
+    keep = first
+    # compact kept entries to the front (stable sort by drop flag)
+    dropk = (~keep).astype(jnp.int32)
+    ops = [dropk] + [skeys[i] for i in range(L)] + [newval]
+    c = lax.sort(ops, num_keys=1, is_stable=True)
+    ckeys = jnp.stack(c[1:1 + L])
+    cvals = c[1 + L]
+    n1 = jnp.sum(keep.astype(jnp.int32))
+    # coalesce: segment i redundant if value equals previous kept value
+    idx = jnp.arange(KA)
+    prev_val = jnp.concatenate([jnp.full(1, NEG, jnp.int32), cvals[:-1]])
+    keep2 = (idx < n1) & ((idx == 0) | (cvals != prev_val))
+    dropk2 = (~keep2).astype(jnp.int32)
+    ops = [dropk2] + [ckeys[i] for i in range(L)] + [cvals]
+    c2 = lax.sort(ops, num_keys=1, is_stable=True)
+    fkeys = jnp.stack(c2[1:1 + L])
+    fvals = c2[1 + L]
+    n2 = jnp.sum(keep2.astype(jnp.int32))
+
+    overflow = n2 > K
+    out_keys = fkeys[:, :K]
+    out_vals = jnp.where(jnp.arange(K) < n2, fvals[:K], NEG)
+    out_keys = jnp.where((jnp.arange(K) < n2)[None, :], out_keys,
+                         jnp.broadcast_to(maxk, (L, K)))
+    new_table = _build_table(out_vals)
+
+    new_state = {
+        "bkeys": out_keys,
+        "bval": out_vals,
+        "nb": jnp.minimum(n2, K).astype(jnp.int32),
+        "oldest": new_oldest.astype(jnp.int32),
+        "table": new_table,
+    }
+    info = {"overflow": overflow, "boundaries": n2,
+            "committed": jnp.sum(commit.astype(jnp.int32))}
+    return new_state, statuses, info
+
+
+def rebase_state(state: dict, delta: int):
+    """Shift all version offsets down by delta (host rebases the int64 base)."""
+    d = jnp.int32(delta)
+    bval = jnp.maximum(state["bval"] - d, NEG)
+    return {
+        "bkeys": state["bkeys"],
+        "bval": bval,
+        "nb": state["nb"],
+        "oldest": jnp.maximum(state["oldest"] - d, NEG),
+        "table": _build_table(bval),
+    }
+
+
+def init_state(shapes: ConflictShapes, oldest: int = 0):
+    K = shapes.capacity
+    maxk = np.full((L, K), 0xFFFFFFFF, dtype=np.uint32)
+    maxk[:, 0] = keylib.MIN_LIMBS  # segment 0: [b"", next) -> NEG
+    bval = np.full(K, int(NEG), dtype=np.int32)
+    return {
+        "bkeys": jnp.asarray(maxk),
+        "bval": jnp.asarray(bval),
+        "nb": jnp.int32(1),
+        "oldest": jnp.int32(oldest),
+        "table": _build_table(jnp.asarray(bval)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# host wrapper: the ConflictSet a Resolver instantiates
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _compiled_step(shapes: ConflictShapes, max_write_life: int):
+    """One compiled program per (shapes, window) — shared across instances."""
+    return jax.jit(functools.partial(
+        conflict_step, shapes=shapes, max_write_life=max_write_life))
+
+class DeviceConflictSet:
+    """Drop-in conflict set backed by the jitted device step.
+
+    Mirrors the seam in fdbserver/ConflictSet.h:27-44: construct, feed batches
+    of TxnConflictInfo, get {CONFLICT, TOO_OLD, COMMITTED} per transaction.
+    Arbitrary batch sizes are handled by chunking to the static shape
+    (chunk order preserves batch order, so intra-batch "earlier txns win"
+    semantics are exact: later chunks see earlier chunks' merged writes).
+    """
+
+    def __init__(self, capacity: int | None = None, txns: int | None = None,
+                 reads_per_txn: int | None = None, writes_per_txn: int | None = None,
+                 oldest_version: int = 0):
+        k = KNOBS
+        self.shapes = ConflictShapes(
+            capacity=capacity or k.CONFLICT_STATE_CAPACITY,
+            txns=txns or k.CONFLICT_BATCH_TXNS,
+            reads=(txns or k.CONFLICT_BATCH_TXNS) * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
+            writes=(txns or k.CONFLICT_BATCH_TXNS) * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
+        )
+        self.base_version = oldest_version
+        self.oldest_version = oldest_version
+        self._state = init_state(self.shapes, oldest=0)
+        self._step = _compiled_step(self.shapes,
+                                    KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+
+    # -- encoding --
+    def _encode_batch(self, txns: list[TxnConflictInfo], commit_version: int,
+                      skip: list[bool] | None = None):
+        sh = self.shapes
+        T = sh.txns
+        assert len(txns) <= T
+        rb = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
+        re = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
+        wb = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
+        we = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
+        rtxn = np.full(sh.reads, T, np.int32)
+        wtxn = np.full(sh.writes, T, np.int32)
+        snap = np.zeros(T, np.int32)
+        valid = np.zeros(T, bool)
+        ri = wi = 0
+        for t, txn in enumerate(txns):
+            if skip is not None and skip[t]:
+                continue  # host already decided TOO_OLD; not in this batch
+            valid[t] = True
+            snap[t] = self._clamp_off(txn.read_snapshot)
+            for b, e in txn.read_ranges:
+                rb[:, ri] = keylib.encode_key(b)
+                re[:, ri] = keylib.encode_key(e, round_up=True)
+                rtxn[ri] = t
+                ri += 1
+            for b, e in txn.write_ranges:
+                wb[:, wi] = keylib.encode_key(b)
+                we[:, wi] = keylib.encode_key(e, round_up=True)
+                wtxn[wi] = t
+                wi += 1
+        return {
+            "rb": jnp.asarray(rb), "re": jnp.asarray(re), "rtxn": jnp.asarray(rtxn),
+            "wb": jnp.asarray(wb), "we": jnp.asarray(we), "wtxn": jnp.asarray(wtxn),
+            "snapshot": jnp.asarray(snap), "txn_valid": jnp.asarray(valid),
+            "commit_version": jnp.int32(self._clamp_off(commit_version)),
+            "advance_floor": jnp.asarray(True),
+        }
+
+    def _clamp_off(self, version: int) -> int:
+        off = version - self.base_version
+        return int(max(min(off, (1 << 31) - 1), int(NEG)))
+
+    def _maybe_rebase(self, commit_version: int):
+        # Shift in <= 2^30 steps so each delta fits int32; values saturate at
+        # NEG, so repeated shifts are exact for any version gap.
+        while commit_version - self.base_version > _REBASE_THRESHOLD:
+            delta = min(commit_version - self.base_version - (1 << 24), 1 << 30)
+            self._state = rebase_state(self._state, delta)
+            self.base_version += delta
+
+    # -- ConflictBatch interface --
+    def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
+        self._maybe_rebase(commit_version)
+        out: list[int] = []
+        subs = self._split_for_capacity(txns)
+        # The too-old decision is taken here with exact int64 versions (device
+        # offsets saturate across extreme rebases); flagged txns are excluded
+        # from the device batch entirely.
+        pre_batch_oldest = self.oldest_version
+        for i, sub in enumerate(subs):
+            host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
+                            for t in sub]
+            batch = self._encode_batch(sub, commit_version, skip=host_too_old)
+            # the MVCC floor advances once per logical batch (last chunk), so
+            # every chunk's too-old check uses the pre-batch floor
+            batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
+            new_state, statuses, info = self._step(self._state, batch)
+            if bool(info["overflow"]):
+                # do NOT install the truncated state — truncation drops the
+                # highest-key history segments and would cause false commits.
+                # Chunks before this one are already merged; the owner must
+                # treat this as fatal and reconstruct (clearConflictSet
+                # semantics: conflict state is soft, SkipList.cpp:957).
+                raise FDBError("internal_error",
+                               "conflict state capacity exceeded; raise CONFLICT_STATE_CAPACITY")
+            self._state = new_state
+            dev_statuses = np.asarray(statuses[:len(sub)])
+            out.extend(TOO_OLD if old else int(s)
+                       for s, old in zip(dev_statuses, host_too_old))
+        self.oldest_version = self.base_version + int(self._state["oldest"])
+        return out
+
+    def _split_for_capacity(self, txns):
+        sh = self.shapes
+        subs, cur, nr, nw = [], [], 0, 0
+        for txn in txns:
+            tr, tw = len(txn.read_ranges), len(txn.write_ranges)
+            if tr > sh.reads or tw > sh.writes:
+                raise FDBError("transaction_too_large",
+                               f"{tr} reads / {tw} writes exceed batch shape")
+            if cur and (nr + tr > sh.reads or nw + tw > sh.writes or len(cur) >= sh.txns):
+                subs.append(cur)
+                cur, nr, nw = [], 0, 0
+            cur.append(txn)
+            nr += tr
+            nw += tw
+        subs.append(cur)
+        return subs
+
+    def clear(self, oldest_version: int = 0):
+        """clearConflictSet (SkipList.cpp:957): state is soft/reconstructable."""
+        self.base_version = oldest_version
+        self.oldest_version = oldest_version
+        self._state = init_state(self.shapes, oldest=0)

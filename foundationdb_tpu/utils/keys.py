@@ -25,14 +25,31 @@ KEY_BYTES = 24
 NUM_LIMBS = KEY_BYTES // 4 + 1  # 6 data limbs + 1 length limb = 7
 
 
-def encode_key(key: bytes, out: np.ndarray | None = None) -> np.ndarray:
-    """Encode one key to a (NUM_LIMBS,) uint32 vector."""
+def encode_key(key: bytes, out: np.ndarray | None = None, round_up: bool = False) -> np.ndarray:
+    """Encode one key to a (NUM_LIMBS,) uint32 vector.
+
+    A key longer than KEY_BYTES is not exactly representable; the encoding
+    must round *conservatively* depending on which end of a half-open range
+    the key is:
+
+    - range BEGIN (round_up=False): truncation rounds down (the encoded key
+      sorts <= the real key), growing the range leftward — safe.
+    - range END (round_up=True): the encoding is the supremum of every key
+      sharing the truncated prefix (length limb KEY_BYTES+1 sorts strictly
+      after all real keys with that prefix), growing the range rightward —
+      safe. Without this, a range whose endpoints share a 24-byte prefix
+      would collapse to empty and a committed write would vanish from
+      history: a false commit.
+    """
     if out is None:
         out = np.zeros(NUM_LIMBS, dtype=np.uint32)
     k = key[:KEY_BYTES]
     padded = k + b"\x00" * (KEY_BYTES - len(k))
     out[: NUM_LIMBS - 1] = np.frombuffer(padded, dtype=">u4")
-    out[NUM_LIMBS - 1] = min(len(key), KEY_BYTES)
+    if len(key) > KEY_BYTES and round_up:
+        out[NUM_LIMBS - 1] = KEY_BYTES + 1
+    else:
+        out[NUM_LIMBS - 1] = min(len(key), KEY_BYTES)
     return out
 
 

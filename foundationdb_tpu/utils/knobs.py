@@ -74,7 +74,6 @@ KNOBS.init("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 100_000)
 
 # --- Conflict engine (device) ---
 KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "oracle" (CPU reference)
-KNOBS.init("CONFLICT_KEY_BYTES", 24)  # exact-comparison key width on device
 KNOBS.init("CONFLICT_STATE_CAPACITY", 1 << 16, (1 << 10,))  # boundary slots
 KNOBS.init("CONFLICT_BATCH_TXNS", 1024)  # static batch shape: txns
 KNOBS.init("CONFLICT_BATCH_READS_PER_TXN", 4)

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Persistent compile cache: the conflict-engine program is compiled once per
+# (shapes, window) and reused across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/fdb_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from foundationdb_tpu.utils.knobs import KNOBS  # noqa: E402
 

@@ -1,0 +1,299 @@
+"""Conflict engine tests: device kernel vs CPU oracle — identical decisions.
+
+This is the oracle-test pattern the reference uses for its own conflict engine
+(SkipList.cpp:1394 miniConflictSetTest cross-checks the bitmask against a
+naive implementation): generate randomized batches, run both engines, assert
+byte-identical abort decisions.
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnConflictInfo
+from foundationdb_tpu.ops.conflict import DeviceConflictSet
+from foundationdb_tpu.ops.conflict_oracle import OracleConflictSet
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+def small_device_set(**kw):
+    kw.setdefault("capacity", 1024)
+    kw.setdefault("txns", 64)
+    kw.setdefault("reads_per_txn", 4)
+    kw.setdefault("writes_per_txn", 4)
+    return DeviceConflictSet(**kw)
+
+
+def both():
+    return small_device_set(), OracleConflictSet()
+
+
+def txn(snap, reads=(), writes=()):
+    return TxnConflictInfo(read_snapshot=snap,
+                           read_ranges=list(reads), write_ranges=list(writes))
+
+
+def check(dev, oracle, txns, version):
+    got = dev.detect(txns, version)
+    want = oracle.detect(txns, version)
+    assert got == want, f"device={got} oracle={want} @v{version}"
+    return got
+
+
+# ---------------------------------------------------------------------------
+# targeted semantics
+# ---------------------------------------------------------------------------
+
+def test_blind_writes_always_commit():
+    dev, oracle = both()
+    s = check(dev, oracle, [txn(0, writes=[(b"a", b"b")])], 100)
+    assert s == [COMMITTED]
+    # same key again, stale snapshot, still a blind write -> commits
+    s = check(dev, oracle, [txn(0, writes=[(b"a", b"b")])], 200)
+    assert s == [COMMITTED]
+
+
+def test_read_write_conflict_and_snapshot_isolation():
+    dev, oracle = both()
+    check(dev, oracle, [txn(0, writes=[(b"k", b"k\x00")])], 100)
+    # snapshot before the write -> conflict
+    s = check(dev, oracle, [txn(50, reads=[(b"k", b"k\x00")])], 200)
+    assert s == [CONFLICT]
+    # snapshot after the write -> fine
+    s = check(dev, oracle, [txn(150, reads=[(b"k", b"k\x00")])], 300)
+    assert s == [COMMITTED]
+
+
+def test_adjacent_ranges_do_not_conflict():
+    dev, oracle = both()
+    check(dev, oracle, [txn(0, writes=[(b"a", b"b")])], 100)
+    s = check(dev, oracle, [txn(50, reads=[(b"b", b"c")])], 200)  # [a,b) vs [b,c)
+    assert s == [COMMITTED]
+    s = check(dev, oracle, [txn(50, reads=[(b"a\xff\xff", b"b")])], 300)
+    assert s == [CONFLICT]  # strictly inside [a,b)
+
+
+def test_intra_batch_earlier_txn_wins_and_aborted_writes_invisible():
+    dev, oracle = both()
+    batch = [
+        txn(0, writes=[(b"x", b"x\x00")]),                       # commits
+        txn(0, reads=[(b"x", b"x\x00")], writes=[(b"y", b"y\x00")]),  # conflicts with t0
+        txn(0, reads=[(b"y", b"y\x00")]),                        # t1 aborted -> commits
+    ]
+    s = check(dev, oracle, batch, 100)
+    assert s == [COMMITTED, CONFLICT, COMMITTED]
+
+
+def test_intra_batch_long_chain():
+    dev, oracle = both()
+    # t_i reads k_{i-1}, writes k_i: alternating commit/conflict down the chain
+    batch = [txn(0, writes=[(b"k0", b"k0\x00")])]
+    for i in range(1, 20):
+        batch.append(txn(0, reads=[(b"k%d" % (i - 1), b"k%d\x00" % (i - 1))],
+                         writes=[(b"k%d" % i, b"k%d\x00" % i)]))
+    s = check(dev, oracle, batch, 100)
+    assert s == [COMMITTED if i % 2 == 0 else CONFLICT for i in range(20)]
+
+
+def test_own_writes_do_not_conflict_with_own_reads():
+    dev, oracle = both()
+    s = check(dev, oracle,
+              [txn(0, reads=[(b"a", b"b")], writes=[(b"a", b"b")])], 100)
+    assert s == [COMMITTED]
+
+
+def test_too_old():
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 1000)
+    dev, oracle = both()
+    check(dev, oracle, [txn(0, writes=[(b"a", b"b")])], 5000)
+    # window floor is now 4000; snapshot 100 with reads -> too old
+    s = check(dev, oracle, [txn(100, reads=[(b"z", b"z\x00")])], 6000)
+    assert s == [TOO_OLD]
+    # blind write with ancient snapshot is fine
+    s = check(dev, oracle, [txn(100, writes=[(b"z", b"z\x00")])], 6100)
+    assert s == [COMMITTED]
+
+
+def test_window_gc_clamps_but_keeps_recent():
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 1000)
+    dev, oracle = both()
+    check(dev, oracle, [txn(0, writes=[(b"a", b"b")])], 100)
+    check(dev, oracle, [txn(50, writes=[(b"m", b"n")])], 1050)
+    # write@100 is now below the floor (50); snapshot 60 >= floor... but
+    # clamped values make any read of [a,b) with snapshot < floor too old and
+    # with snapshot in [floor, 100) conflict-equivalent. Snapshot 60 reads m:
+    s = check(dev, oracle, [txn(60, reads=[(b"m", b"n")])], 1100)
+    assert s == [CONFLICT]  # write@1050 > 60
+
+
+def test_empty_batch_and_empty_txn():
+    dev, oracle = both()
+    assert check(dev, oracle, [], 100) == []
+    s = check(dev, oracle, [txn(0)], 200)
+    assert s == [COMMITTED]
+
+
+def test_range_write_vs_point_read():
+    dev, oracle = both()
+    check(dev, oracle, [txn(0, writes=[(b"a", b"q")])], 100)
+    s = check(dev, oracle, [txn(10, reads=[(b"m", b"m\x00")])], 200)
+    assert s == [CONFLICT]
+    s = check(dev, oracle, [txn(10, reads=[(b"q", b"q\x00")])], 300)
+    assert s == [COMMITTED]
+
+
+def test_chunking_preserves_batch_order_semantics():
+    dev = DeviceConflictSet(capacity=1024, txns=8, reads_per_txn=2, writes_per_txn=2)
+    oracle = OracleConflictSet()
+    # 20 txns in one logical batch -> 3 device chunks; decisions must match a
+    # single oracle batch exactly.
+    batch = [txn(0, writes=[(b"c0", b"c0\x00")])]
+    for i in range(1, 20):
+        batch.append(txn(0, reads=[(b"c%d" % (i - 1), b"c%d\x00" % (i - 1))],
+                         writes=[(b"c%d" % i, b"c%d\x00" % i)]))
+    got = dev.detect(batch, 100)
+    want = oracle.detect(batch, 100)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# randomized parity (the oracle test)
+# ---------------------------------------------------------------------------
+
+def _random_key(rng, space):
+    return space[rng.randint(0, len(space) - 1)]
+
+
+def _random_range(rng, space):
+    a, b = _random_key(rng, space), _random_key(rng, space)
+    if a == b:
+        return (a, a + b"\x00")
+    return (min(a, b), max(a, b))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_randomized_parity(seed):
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 500)
+    rng = DeterministicRandom(seed)
+    dev = small_device_set()
+    oracle = OracleConflictSet()
+    # small key space -> heavy contention
+    space = [bytes([97 + i]) + bytes([97 + j]) for i in range(6) for j in range(6)]
+    version = 0
+    for _batch in range(25):
+        version += rng.randint(1, 300)
+        txns = []
+        for _ in range(rng.randint(1, 30)):
+            snap = max(0, version - rng.randint(0, 800))
+            reads = [_random_range(rng, space) for _ in range(rng.randint(0, 3))]
+            writes = [_random_range(rng, space) for _ in range(rng.randint(0, 3))]
+            txns.append(txn(snap, reads, writes))
+        check(dev, oracle, txns, version)
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_randomized_parity_long_keys_and_prefixes(seed):
+    rng = DeterministicRandom(seed)
+    dev = small_device_set()
+    oracle = OracleConflictSet()
+    # nested/prefix-structured keys up to 24 bytes (exact-width boundary)
+    space = []
+    for _ in range(40):
+        depth = rng.randint(1, 4)
+        space.append(b"/".join(rng.random_bytes(rng.randint(1, 5)) for _ in range(depth))[:24])
+    version = 0
+    for _batch in range(15):
+        version += rng.randint(1, 200)
+        txns = [txn(max(0, version - rng.randint(0, 400)),
+                    [_random_range(rng, space) for _ in range(rng.randint(0, 4))],
+                    [_random_range(rng, space) for _ in range(rng.randint(0, 4))])
+                for _ in range(rng.randint(1, 20))]
+        check(dev, oracle, txns, version)
+
+
+def test_long_key_truncation_never_false_commits():
+    """Keys sharing a 24-byte prefix collapse on device; the collapse must
+    round range ENDS up, so committed writes on long keys stay in history
+    (a collapsed-to-empty write range would be a false commit)."""
+    dev = small_device_set()
+    long_a = b"p" * 28 + b"AAAA"
+    long_b = b"p" * 28 + b"BBBB"  # distinct keys, same 24B prefix
+    dev.detect([txn(0, writes=[(long_a, long_a + b"\x00")])], 100)
+    s = dev.detect([txn(50, reads=[(long_b, long_b + b"\x00")])], 200)
+    assert s == [CONFLICT]  # false conflict (collapse) — but never a miss
+    s = dev.detect([txn(150, reads=[(long_b, long_b + b"\x00")])], 300)
+    assert s == [COMMITTED]  # fresh snapshot sees past the write
+
+
+def test_inverted_write_range_does_not_cancel_other_writes():
+    """An inverted range (end < begin) must be inert: in the coverage
+    prefix-sum a reversed -1/+1 delta pair would cancel a real write's
+    coverage and drop it from history (false commit)."""
+    dev, oracle = both()
+    batch = [txn(0, writes=[(b"c", b"a")]),  # inverted
+             txn(0, writes=[(b"b", b"d")])]
+    check(dev, oracle, batch, 100)
+    s = check(dev, oracle, [txn(50, reads=[(b"b", b"b\x00")])], 200)
+    assert s == [CONFLICT]  # txn2's write survived the inverted neighbor
+
+
+def test_empty_and_inverted_ranges_are_inert_intra_batch():
+    dev, oracle = both()
+    batch = [
+        txn(0, writes=[(b"a", b"z")]),
+        txn(0, reads=[(b"m", b"m")]),          # empty read inside [a,z)
+        txn(0, reads=[(b"q", b"c")]),          # inverted read
+        txn(0, writes=[(b"zx", b"c")], reads=[]),  # inverted write
+        txn(0, reads=[(b"zx", b"zx\x00")]),  # inside inverted write only: inert
+    ]
+    s = check(dev, oracle, batch, 100)
+    assert s == [COMMITTED, COMMITTED, COMMITTED, COMMITTED, COMMITTED]
+
+
+def test_chunked_batch_uses_pre_batch_window_floor():
+    """The MVCC floor advances once per logical batch: a txn in a later
+    chunk must not see the floor moved by an earlier chunk."""
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 1000)
+    dev = DeviceConflictSet(capacity=1024, txns=2, reads_per_txn=2, writes_per_txn=2)
+    oracle = OracleConflictSet()
+    batch = [txn(4900, writes=[(b"a", b"b")]),
+             txn(4900, writes=[(b"c", b"d")]),
+             txn(100, reads=[(b"zz", b"zz\x00")])]  # 3rd txn -> 2nd chunk
+    got = dev.detect(batch, 5000)
+    want = oracle.detect(batch, 5000)
+    assert got == want == [COMMITTED, COMMITTED, COMMITTED]
+    # after the batch, the floor HAS advanced (4000): now it is too old
+    got = dev.detect([txn(100, reads=[(b"zz", b"zz\x00")])], 5100)
+    want = oracle.detect([txn(100, reads=[(b"zz", b"zz\x00")])], 5100)
+    assert got == want == [TOO_OLD]
+
+
+def test_overflow_leaves_set_with_untruncated_state():
+    tiny = DeviceConflictSet(capacity=64, txns=32, reads_per_txn=1, writes_per_txn=1)
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        v = 0
+        for i in range(20):
+            v += 10
+            tiny.detect([txn(0, writes=[(b"%04d" % (i * 31 + j), b"%04da" % (i * 31 + j))])
+                         for j in range(31)], v)
+    # the state the set holds must still satisfy its own invariant: nb <= K
+    assert int(tiny._state["nb"]) <= 64
+
+
+def test_state_survives_many_batches_with_gc():
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 1000)
+    rng = DeterministicRandom(99)
+    dev = small_device_set(capacity=512)
+    oracle = OracleConflictSet()
+    space = [b"k%02d" % i for i in range(30)]
+    version = 0
+    for _ in range(60):
+        version += rng.randint(50, 200)
+        txns = [txn(max(0, version - rng.randint(0, 1500)),
+                    [_random_range(rng, space)],
+                    [_random_range(rng, space)])
+                for _ in range(rng.randint(1, 10))]
+        check(dev, oracle, txns, version)
+    # GC must keep the boundary count bounded by the live key space
+    assert int(dev._state["nb"]) <= 2 * len(space) + 2

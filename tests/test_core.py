@@ -72,6 +72,50 @@ def test_actor_cancellation():
     assert witness == ["operation_cancelled"]
 
 
+def test_actor_can_swallow_cancel_and_await_cleanup():
+    loop = EventLoop()
+    done = []
+
+    async def actor():
+        try:
+            await loop.delay(100.0)
+        except FDBError:
+            await loop.delay(0.5)  # cleanup await after swallowing the cancel
+            done.append(loop.now())
+            return "cleaned"
+
+    t = loop.spawn(actor())
+    loop._schedule(1.0, TaskPriority.DefaultDelay, t.cancel)
+    assert loop.run_future(t, max_time=50.0) == "cleaned"
+    assert done and done[0] == pytest.approx(1.5)
+
+
+def test_run_future_timeout_does_not_lose_events():
+    loop = EventLoop()
+    p = Promise()
+    fired = []
+    loop._schedule(12.0, TaskPriority.DefaultDelay, lambda: fired.append(True))
+    with pytest.raises(FDBError, match="timed_out"):
+        loop.run_future(p.future, max_time=10.0)
+    loop.run_until_idle()
+    assert fired == [True]  # the popped t=12 event was restored and ran
+
+
+def test_completed_actors_do_not_accumulate_on_process():
+    loop = EventLoop()
+    from foundationdb_tpu.core.sim import SimNetwork
+    net = SimNetwork(loop, DeterministicRandom(1))
+    p = net.new_process("s:1")
+
+    async def quick():
+        await loop.delay(0.001)
+
+    for _ in range(50):
+        p.spawn(quick())
+    loop.run_until_idle()
+    assert p.actors == []
+
+
 def test_promise_stream():
     loop = EventLoop()
     stream = PromiseStream()
