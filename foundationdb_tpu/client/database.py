@@ -1,0 +1,101 @@
+"""Database: client handle bound to a cluster (proxies + storage endpoints).
+
+Reference: fdbclient/NativeAPI.actor.cpp Database/DatabaseContext — owns the
+shard-location cache, the read-version batcher (:2709), and the retry-loop
+helper every binding exposes as `@fdb.transactional` (the RYW commit/onError
+loop, bindings/python/fdb/impl.py).
+
+The GRV batcher coalesces concurrent read-version requests into one proxy
+round-trip per GRV_BATCH_INTERVAL, like readVersionBatcher.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.client.transaction import Transaction
+from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.server.interfaces import (
+    GetKeyValuesRequest, GetReadVersionRequest, GetValueRequest, Token,
+    WatchValueRequest)
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+class Database:
+    def __init__(self, process: SimProcess, proxies: list[str],
+                 storage_for_key, rng: DeterministicRandom | None = None):
+        """`storage_for_key(key) -> address` is the location cache stand-in;
+        with data distribution it becomes a real cached shard map."""
+        self.process = process
+        self.loop = process.net.loop
+        self.proxies = proxies  # proxy process addresses
+        self.storage_for_key = storage_for_key
+        self._rng = rng or DeterministicRandom(0xDB)
+        self._grv_waiters: list[Future] = []
+        self._grv_armed = False
+
+    def create_transaction(self) -> Transaction:
+        return Transaction(self)
+
+    async def transact(self, fn, max_retries: int = 100):
+        """Run `await fn(tr)` then commit, retrying per onError — the
+        @fdb.transactional contract."""
+        tr = self.create_transaction()
+        for _ in range(max_retries):
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except FDBError as e:
+                await tr.on_error(e)  # re-raises when not retryable
+        raise FDBError("operation_failed", "transact: retry limit exhausted")
+
+    # -- RPC plumbing used by Transaction --
+
+    def _pick_proxy(self, token: int) -> Endpoint:
+        addr = self.proxies[self._rng.randint(0, len(self.proxies) - 1)]
+        return Endpoint(addr, token)
+
+    def _grv(self) -> Future:
+        """Batched read-version fetch (readVersionBatcher :2709)."""
+        f = Future()
+        self._grv_waiters.append(f)
+        if not self._grv_armed:
+            self._grv_armed = True
+            self.process.spawn(self._grv_flush(), "grvBatcher")
+        return f
+
+    async def _grv_flush(self):
+        await self.loop.delay(KNOBS.GRV_BATCH_INTERVAL)
+        waiters, self._grv_waiters = self._grv_waiters, []
+        self._grv_armed = False
+        try:
+            reply = await self.process.net.request(
+                self.process, self._pick_proxy(Token.PROXY_GET_READ_VERSION),
+                GetReadVersionRequest())
+            for w in waiters:
+                if not w.is_ready():
+                    w._set(reply)
+        except FDBError as e:
+            for w in waiters:
+                if not w.is_ready():
+                    w._set_error(FDBError(e.name, e.detail))
+
+    def _get_value(self, req: GetValueRequest) -> Future:
+        ep = Endpoint(self.storage_for_key(req.key), Token.STORAGE_GET_VALUE)
+        return self.process.net.request(self.process, ep, req)
+
+    def _get_range(self, req: GetKeyValuesRequest) -> Future:
+        # single-shard for now: the begin selector's owner serves the range
+        ep = Endpoint(self.storage_for_key(req.begin.key),
+                      Token.STORAGE_GET_KEY_VALUES)
+        return self.process.net.request(self.process, ep, req)
+
+    def _watch(self, req: WatchValueRequest) -> Future:
+        ep = Endpoint(self.storage_for_key(req.key), Token.STORAGE_WATCH_VALUE)
+        return self.process.net.request(self.process, ep, req)
+
+    def _commit(self, req) -> Future:
+        return self.process.net.request(
+            self.process, self._pick_proxy(Token.PROXY_COMMIT), req)

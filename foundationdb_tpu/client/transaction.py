@@ -1,0 +1,285 @@
+"""Transaction: the client API with read-your-writes and retry semantics.
+
+Reference: fdbclient/NativeAPI.actor.cpp Transaction (get :1869, getRange
+:1989, set :2072, clear :2116, atomicOp :2090, watch :1923, commit :2580,
+onError :2180) merged with the ReadYourWrites overlay
+(fdbclient/ReadYourWrites.actor.cpp) the bindings actually use: reads see
+uncommitted writes, and precise read conflict ranges accumulate as reads
+happen (snapshot reads skip them).
+
+All methods are actors on the framework event loop (await our Futures).
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.client.writemap import WriteMap
+from foundationdb_tpu.server.interfaces import (
+    CommitTransactionRequest, GetKeyValuesRequest, GetReadVersionRequest,
+    GetValueRequest, KeySelector, Token, WatchValueRequest)
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.types import ATOMIC_OPS, MutationType
+
+
+class Transaction:
+    def __init__(self, db):
+        self.db = db
+        self.reset()
+
+    def reset(self):
+        self._writes = WriteMap()
+        self._read_conflicts: list[tuple[bytes, bytes]] = []
+        self._extra_write_conflicts: list[tuple[bytes, bytes]] = []
+        self._read_version: int | None = None
+        self._rv_future = None
+        self._committed_version: int | None = None
+        self._backoff = KNOBS.DEFAULT_BACKOFF
+        self._committing = False
+
+    # -- read version --
+
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            reply = await self.db._grv()
+            self._read_version = reply.version
+        return self._read_version
+
+    def set_read_version(self, version: int):
+        self._read_version = version
+
+    # -- reads --
+
+    async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        self._check_key(key)
+        has_point, point, cleared = self._writes.lookup(key)
+        if has_point and point.known:
+            return point.value  # fully client-determined
+        if cleared:
+            return None
+        version = await self.get_read_version()
+        reply = await self.db._get_value(GetValueRequest(key=key, version=version))
+        if not snapshot:
+            self._read_conflicts.append((key, key + b"\x00"))
+        base = reply.value
+        if has_point:
+            return point.resolve(base)  # pending atomic ops over storage value
+        return base
+
+    async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
+        """Resolve a key selector (NativeAPI getKey). RYW-merged via a
+        range read of plain byte bounds (avoids selector-end exclusivity)."""
+        sel = selector
+        if sel.offset >= 1:
+            begin = sel.key + (b"\x00" if sel.or_equal else b"")
+            data = await self.get_range(begin, b"\xff", limit=sel.offset,
+                                        snapshot=snapshot)
+            if len(data) >= sel.offset:
+                return data[sel.offset - 1][0]
+            return b"\xff"
+        nth = 1 - sel.offset
+        end = sel.key + (b"\x00" if sel.or_equal else b"")
+        data = await self.get_range(b"", end, limit=nth, reverse=True,
+                                    snapshot=snapshot)
+        if len(data) >= nth:
+            return data[nth - 1][0]
+        return b""
+
+    async def get_range(self, begin, end, limit: int = 0, reverse: bool = False,
+                        snapshot: bool = False) -> list[tuple[bytes, bytes]]:
+        """Range read, RYW-merged. begin/end may be bytes or KeySelectors.
+
+        Non-canonical selectors resolve against the merged view first (the
+        reference's RYW layer resolves selectors over RYWIterator); the body
+        then scans [resolve(begin), resolve(end)) with continuation fetches
+        until the limit is satisfied or storage is exhausted, so overlay
+        clears can never starve a limited read.
+        """
+        if isinstance(begin, bytes):
+            begin = KeySelector.first_greater_or_equal(begin)
+        if isinstance(end, bytes):
+            end = KeySelector.first_greater_or_equal(end)
+        version = await self.get_read_version()
+        if not _canonical(begin):
+            begin = KeySelector.first_greater_or_equal(
+                await self.get_key(begin, snapshot=snapshot))
+        if not _canonical(end):
+            end = KeySelector.first_greater_or_equal(
+                await self.get_key(end, snapshot=snapshot))
+        win_lo, win_hi = begin.key, end.key
+        if win_lo >= win_hi:
+            return []
+
+        overlay_slack = 8 + sum(1 for k, _p in
+                                self._writes.points_in_range(win_lo, win_hi)) \
+            if self._writes else 0
+        fetch_limit = (limit + overlay_slack) if limit else 0
+
+        rows: dict[bytes, bytes] = {}
+        merged: list[tuple[bytes, bytes]] = []
+        cur_lo, cur_hi = win_lo, win_hi  # uncovered remainder of the window
+        while cur_lo < cur_hi:
+            req = GetKeyValuesRequest(
+                begin=KeySelector.first_greater_or_equal(cur_lo),
+                end=KeySelector.first_greater_or_equal(cur_hi),
+                version=version, limit=fetch_limit, reverse=reverse)
+            reply = await self.db._get_range(req)
+            rows.update(reply.data)
+            if reply.more and reply.data:
+                if reverse:
+                    cur_hi = reply.data[-1][0]
+                else:
+                    cur_lo = reply.data[-1][0] + b"\x00"
+            elif reverse:
+                cur_hi = cur_lo  # fully covered
+            else:
+                cur_lo = cur_hi  # fully covered
+            cov_lo = win_lo if not reverse else cur_hi
+            cov_hi = win_hi if reverse else cur_lo
+            merged = self._merge_overlay(rows, cov_lo, cov_hi, reverse)
+            if limit and len(merged) >= limit:
+                break
+        if limit:
+            merged = merged[:limit]
+
+        if not snapshot:
+            # precise read conflict: the window actually observed
+            if merged and limit and len(merged) == limit and cur_lo < cur_hi:
+                if reverse:
+                    con_lo, con_hi = merged[-1][0], win_hi
+                else:
+                    con_lo, con_hi = win_lo, merged[-1][0] + b"\x00"
+            else:
+                con_lo, con_hi = win_lo, win_hi
+            if con_lo < con_hi:
+                self._read_conflicts.append((con_lo, con_hi))
+        return merged
+
+    def _merge_overlay(self, rows, lo, hi, reverse):
+        """Merge storage rows with the write overlay inside [lo, hi)."""
+        rows = {k: v for k, v in rows.items() if lo <= k < hi}
+        # remove cleared rows
+        for b, e in self._writes.clears_intersecting(lo, hi):
+            for k in [k for k in rows if b <= k < e]:
+                del rows[k]
+        # apply point writes
+        for k, p in self._writes.points_in_range(lo, hi):
+            v = p.resolve(rows.get(k)) if not p.known else p.value
+            if v is None:
+                rows.pop(k, None)
+            else:
+                rows[k] = v
+        out = sorted(rows.items(), reverse=reverse)
+        return out
+
+    async def watch(self, key: bytes):
+        """Future resolving when `key`'s value changes after commit time."""
+        version = await self.get_read_version()
+        value = await self.get(key, snapshot=True)
+        return self.db._watch(WatchValueRequest(key=key, value=value,
+                                                version=version))
+
+    # -- writes --
+
+    def set(self, key: bytes, value: bytes):
+        self._check_key(key)
+        self._check_value(value)
+        self._writes.set(key, value)
+
+    def clear(self, key: bytes):
+        self._check_key(key)
+        self._writes.clear_range(key, key + b"\x00")
+
+    def clear_range(self, begin: bytes, end: bytes):
+        self._check_key(begin)
+        if begin < end:
+            self._writes.clear_range(begin, end)
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes):
+        if op not in ATOMIC_OPS:
+            raise FDBError("invalid_mutation_type", str(op))
+        self._check_key(key)
+        self._writes.atomic_op(op, key, operand)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes):
+        if begin < end:
+            self._read_conflicts.append((begin, end))
+
+    def add_read_conflict_key(self, key: bytes):
+        self._read_conflicts.append((key, key + b"\x00"))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes):
+        if begin < end:
+            self._extra_write_conflicts.append((begin, end))
+
+    # -- commit --
+
+    async def commit(self):
+        if self._committing:
+            raise FDBError("used_during_commit")
+        self._committing = True
+        try:
+            if not self._writes:
+                # read-only: nothing to do (reference: commit of RO txn is local)
+                self._committed_version = self._read_version or 0
+                return
+            version = await self.get_read_version() if self._read_conflicts \
+                else (self._read_version or 0)
+            req = CommitTransactionRequest(
+                read_snapshot=version,
+                read_conflict_ranges=_coalesce(self._read_conflicts),
+                write_conflict_ranges=self._writes.write_conflict_ranges()
+                + getattr(self, "_extra_write_conflicts", []),
+                mutations=list(self._writes.mutations))
+            self._check_size(req)
+            reply = await self.db._commit(req)
+            self._committed_version = reply.version
+        finally:
+            self._committing = False
+
+    @property
+    def committed_version(self) -> int | None:
+        return self._committed_version
+
+    async def on_error(self, error: FDBError):
+        """The retry contract (NativeAPI Transaction::onError :2180): backoff
+        then reset, re-raise if not retryable."""
+        if not isinstance(error, FDBError) or not error.is_retryable:
+            raise error
+        backoff = self._backoff
+        await self.db.loop.delay(backoff * (0.5 + self.db._rng.random()))
+        new_backoff = min(backoff * 2, KNOBS.MAX_BACKOFF)
+        self.reset()
+        self._backoff = new_backoff
+
+    # -- limits (fdbclient/Knobs.cpp size limits) --
+
+    def _check_key(self, key: bytes):
+        if len(key) > KNOBS.KEY_SIZE_LIMIT:
+            raise FDBError("key_too_large")
+
+    def _check_value(self, value: bytes):
+        if len(value) > KNOBS.VALUE_SIZE_LIMIT:
+            raise FDBError("value_too_large")
+
+    def _check_size(self, req: CommitTransactionRequest):
+        size = sum(m.weight() for m in req.mutations)
+        size += sum(len(b) + len(e) for b, e in req.read_conflict_ranges)
+        if size > KNOBS.TRANSACTION_SIZE_LIMIT:
+            raise FDBError("transaction_too_large")
+
+
+def _coalesce(ranges: list[tuple[bytes, bytes]]) -> list[tuple[bytes, bytes]]:
+    out: list[tuple[bytes, bytes]] = []
+    for b, e in sorted(r for r in ranges if r[0] < r[1]):
+        if out and b <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((b, e))
+    return out
+
+
+def _canonical(sel: KeySelector) -> bool:
+    """firstGreaterOrEqual — resolvable as a plain byte bound: the first
+    merged-live key at/after the base IS the resolution, so no merged key
+    below the base can be in the result and the base is an exact bound."""
+    return not sel.or_equal and sel.offset == 1

@@ -1,0 +1,102 @@
+"""SimCluster: boot a whole database cluster inside the deterministic simulator.
+
+Reference: fdbserver/SimulatedCluster.actor.cpp (setupSimulatedSystem :1239) —
+the simulator runs the REAL role code on simulated processes; tests then drive
+workloads against a Database handle and inject faults through the SimNetwork.
+
+Topology for this slice: 1 master, P proxies, R resolvers (key-partitioned),
+L tlogs (replicated; quorum = L - antiquorum), S storage servers
+(key-sharded, one tag each). Recruitment/recovery arrive with the
+distribution milestone; here roles are constructed directly.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.core.eventloop import EventLoop
+from foundationdb_tpu.core.sim import Endpoint, SimNetwork, SimProcess
+from foundationdb_tpu.server.interfaces import Token
+from foundationdb_tpu.server.master import Master
+from foundationdb_tpu.server.proxy import Proxy, ResolverMap, ShardMap
+from foundationdb_tpu.server.resolver import Resolver
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+def _partition_boundaries(n: int) -> list[bytes]:
+    """n contiguous key-space partitions: [b""] + n-1 single-byte cuts."""
+    if n <= 1:
+        return [b""]
+    return [b""] + [bytes([int(256 * i / n)]) for i in range(1, n)]
+
+
+class SimCluster:
+    def __init__(self, seed: int = 0, n_proxies: int = 1, n_resolvers: int = 1,
+                 n_tlogs: int = 1, n_storage: int = 1):
+        self.loop = EventLoop()
+        self.rng = DeterministicRandom(seed)
+        self.net = SimNetwork(self.loop, self.rng.fork())
+
+        # -- processes --
+        self.master_proc = self.net.new_process("master:0", dc_id="dc0")
+        self.proxy_procs = [self.net.new_process(f"proxy:{i}") for i in range(n_proxies)]
+        self.resolver_procs = [self.net.new_process(f"resolver:{i}") for i in range(n_resolvers)]
+        self.tlog_procs = [self.net.new_process(f"tlog:{i}") for i in range(n_tlogs)]
+        self.storage_procs = [self.net.new_process(f"storage:{i}") for i in range(n_storage)]
+
+        # -- endpoints --
+        master_ep = Endpoint("master:0", Token.MASTER_GET_COMMIT_VERSION)
+        resolver_eps = [Endpoint(p.address, Token.RESOLVER_RESOLVE)
+                        for p in self.resolver_procs]
+        tlog_eps = [Endpoint(p.address, Token.TLOG_COMMIT) for p in self.tlog_procs]
+        self.proxy_addrs = [p.address for p in self.proxy_procs]
+
+        # -- role state --
+        self.master = Master(self.master_proc)
+        self.resolvers = [Resolver(p) for p in self.resolver_procs]
+        self.tlogs = [TLog(p) for p in self.tlog_procs]
+
+        # storage sharding: shard i served by storage i (tag = i); every tlog
+        # holds every tag (replication = n_tlogs over the same data for now)
+        self.shard_boundaries = _partition_boundaries(n_storage)
+        shard_map = ShardMap(boundaries=self.shard_boundaries,
+                             tags=[[i] for i in range(n_storage)])
+        resolver_map = ResolverMap(
+            boundaries=_partition_boundaries(n_resolvers),
+            endpoints=resolver_eps)
+
+        tlog_addrs = [p.address for p in self.tlog_procs]
+        self.storages = [
+            StorageServer(p, tag=i,
+                          tlog_addrs=tlog_addrs[i % n_tlogs:] + tlog_addrs[:i % n_tlogs])
+            for i, p in enumerate(self.storage_procs)]
+
+        self.proxies = [
+            Proxy(p, proxy_id=i, master=master_ep, resolvers=resolver_map,
+                  tlogs=tlog_eps, shards=shard_map,
+                  other_proxies=[a for a in self.proxy_addrs if a != p.address])
+            for i, p in enumerate(self.proxy_procs)]
+
+    # -- client handles --
+
+    def database(self, name: str = "client:0") -> Database:
+        proc = self.net.processes.get(name) or self.net.new_process(name)
+        boundaries = self.shard_boundaries
+
+        def storage_for_key(key: bytes) -> str:
+            from foundationdb_tpu.utils.keys import partition_index
+            return self.storage_procs[partition_index(boundaries, key)].address
+
+        return Database(proc, self.proxy_addrs, storage_for_key, rng=self.rng.fork())
+
+    # -- driving --
+
+    def run(self, future, max_time: float = 1000.0):
+        """Run the loop until `future` resolves (virtual time)."""
+        return self.loop.run_future(future, max_time=max_time)
+
+    def run_all(self, coros, max_time: float = 1000.0):
+        from foundationdb_tpu.core.future import all_of
+        tasks = [self.loop.spawn(c, name=f"test{i}") for i, c in enumerate(coros)]
+        return self.run(all_of(tasks), max_time=max_time)

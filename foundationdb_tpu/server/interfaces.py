@@ -1,0 +1,214 @@
+"""Request/reply structs and well-known endpoint tokens.
+
+Reference: the *Interface.h headers — MasterInterface.h (GetCommitVersion),
+ResolverInterface.h:83-91 (ResolveTransactionBatchRequest),
+TLogInterface.h (TLogCommitRequest, TLogPeekRequest, TLogPopRequest),
+StorageServerInterface.h (GetValueRequest, GetKeyValuesRequest, WatchValue),
+MasterProxyInterface.h (CommitTransactionRequest, GetReadVersionRequest).
+
+Payloads are plain dataclasses: the simulator delivers them by reference (the
+real transport will serialize; see core/sim.py). Every request that expects a
+reply carries it via the sim's reply-promise mechanism, not a field here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.utils.types import Mutation
+
+
+# Well-known endpoint tokens (fdbrpc/FlowTransport.h WLTOKEN_* pattern).
+class Token:
+    MASTER_GET_COMMIT_VERSION = 1
+    PROXY_COMMIT = 10
+    PROXY_GET_READ_VERSION = 11
+    PROXY_GET_KEY_LOCATIONS = 12
+    PROXY_GET_COMMITTED_VERSION = 13
+    RESOLVER_RESOLVE = 20
+    TLOG_COMMIT = 30
+    TLOG_PEEK = 31
+    TLOG_POP = 32
+    STORAGE_GET_VALUE = 40
+    STORAGE_GET_KEY_VALUES = 41
+    STORAGE_WATCH_VALUE = 42
+    STORAGE_GET_SHARD_STATE = 43
+    WORKER_PING = 90
+
+
+# --- master ---
+
+@dataclass
+class GetCommitVersionRequest:
+    """masterserver.actor.cpp:822 getVersion. requestNum dedupes retransmits."""
+
+    proxy_id: int
+    request_num: int
+
+
+@dataclass
+class GetCommitVersionReply:
+    version: int
+    prev_version: int
+
+
+# --- proxy ---
+
+@dataclass
+class CommitTransactionRequest:
+    """CommitTransaction.h:89-121 CommitTransactionRef + request wrapper."""
+
+    read_snapshot: int
+    read_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    write_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    mutations: list[Mutation] = field(default_factory=list)
+
+
+@dataclass
+class CommitReply:
+    """CommitID on success; errors travel as FDBError through the reply."""
+
+    version: int
+
+
+@dataclass
+class GetReadVersionRequest:
+    """MasterProxyInterface.h GetReadVersionRequest (flags/priority subset)."""
+
+    priority: int = 0
+
+
+@dataclass
+class GetReadVersionReply:
+    version: int
+
+
+# --- resolver ---
+
+@dataclass
+class ResolveTransactionBatchRequest:
+    """ResolverInterface.h:83-91. (prev_version -> version) chains batches
+    into a total order per resolver across all proxies."""
+
+    prev_version: int
+    version: int
+    last_receive_version: int
+    transactions: list  # list[TxnConflictInfo]
+
+
+@dataclass
+class ResolveTransactionBatchReply:
+    committed: list[int]  # per-txn {CONFLICT, TOO_OLD, COMMITTED}
+
+
+# --- tlog ---
+
+@dataclass
+class TLogCommitRequest:
+    """TLogInterface.h TLogCommitRequest: version-ordered mutation push."""
+
+    prev_version: int
+    version: int
+    messages: dict[int, list[Mutation]]  # tag -> mutations for that tag
+    known_committed_version: int = 0
+
+
+@dataclass
+class TLogCommitReply:
+    version: int
+
+
+@dataclass
+class TLogPeekRequest:
+    """Pull messages for `tag` with version >= begin (ILogSystem::peek)."""
+
+    tag: int
+    begin: int
+
+
+@dataclass
+class TLogPeekReply:
+    messages: list[tuple[int, list[Mutation]]]  # [(version, mutations)]
+    end: int  # exclusive: peeker has everything < end for this tag
+    popped: int
+
+
+@dataclass
+class TLogPopRequest:
+    """Advance the durable point: messages for tag below `version` may go."""
+
+    tag: int
+    version: int
+
+
+# --- storage ---
+
+@dataclass
+class GetValueRequest:
+    key: bytes
+    version: int
+
+
+@dataclass
+class GetValueReply:
+    value: bytes | None
+    version: int
+
+
+@dataclass
+class KeySelector:
+    """FDBTypes.h KeySelectorRef: resolves to a key by (base, or_equal, offset).
+
+    first_greater_or_equal(k)  = (k, False, 1)
+    first_greater_than(k)      = (k, True, 1)
+    last_less_or_equal(k)      = (k, True, 0)
+    last_less_than(k)          = (k, False, 0)
+    """
+
+    key: bytes
+    or_equal: bool
+    offset: int
+
+    @staticmethod
+    def first_greater_or_equal(key: bytes) -> "KeySelector":
+        return KeySelector(key, False, 1)
+
+    @staticmethod
+    def first_greater_than(key: bytes) -> "KeySelector":
+        return KeySelector(key, True, 1)
+
+    @staticmethod
+    def last_less_or_equal(key: bytes) -> "KeySelector":
+        return KeySelector(key, True, 0)
+
+    @staticmethod
+    def last_less_than(key: bytes) -> "KeySelector":
+        return KeySelector(key, False, 0)
+
+
+@dataclass
+class GetKeyValuesRequest:
+    """storageserver.actor.cpp:1210 getKeyValues (selectors resolved server-side)."""
+
+    begin: KeySelector
+    end: KeySelector
+    version: int
+    limit: int = 0  # 0 = unlimited (subject to byte limit)
+    limit_bytes: int = 0  # 0 = knob default
+    reverse: bool = False
+
+
+@dataclass
+class GetKeyValuesReply:
+    data: list[tuple[bytes, bytes]]
+    more: bool
+    version: int
+
+
+@dataclass
+class WatchValueRequest:
+    """storageserver.actor.cpp:842 watchValueQ: resolve when value != expected."""
+
+    key: bytes
+    value: bytes | None  # value the client last saw
+    version: int

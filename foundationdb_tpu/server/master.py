@@ -1,0 +1,46 @@
+"""Master role: the commit-version allocator.
+
+Reference: fdbserver/masterserver.actor.cpp — getVersion (:822) hands each
+commit batch a unique, strictly increasing version advancing at
+VERSIONS_PER_SECOND against the clock (:858), and tells the proxy the previous
+version it assigned so downstream stages (resolvers, TLogs) can chain batches
+into a total order with no gaps. Retransmitted requests are deduped by
+(proxy_id, request_num) (:834-843).
+
+Recovery driving (masterCore :1160) arrives with the distribution milestone;
+this slice is the steady-state ACCEPTING_COMMITS behavior.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.sim import SimProcess
+from foundationdb_tpu.server.interfaces import (
+    GetCommitVersionReply, GetCommitVersionRequest, Token)
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+class Master:
+    def __init__(self, process: SimProcess, recovery_version: int = 0):
+        self.process = process
+        self.loop = process.net.loop
+        self.last_version_assigned = recovery_version
+        self.last_version_time = self.loop.now()
+        # (proxy_id -> (request_num, reply)) retransmit dedupe window
+        self._last_reply: dict[int, tuple[int, GetCommitVersionReply]] = {}
+        process.register(Token.MASTER_GET_COMMIT_VERSION, self._on_get_commit_version)
+
+    def _on_get_commit_version(self, req: GetCommitVersionRequest, reply):
+        prev = self._last_reply.get(req.proxy_id)
+        if prev is not None and prev[0] == req.request_num:
+            reply.send(prev[1])  # retransmit: same version again
+            return
+        now = self.loop.now()
+        advance = int((now - self.last_version_time) * KNOBS.VERSIONS_PER_SECOND)
+        advance = max(1, min(advance, KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS))
+        version = self.last_version_assigned + advance
+        r = GetCommitVersionReply(version=version,
+                                  prev_version=self.last_version_assigned)
+        self.last_version_assigned = version
+        self.last_version_time = now
+        self._last_reply[req.proxy_id] = (req.request_num, r)
+        reply.send(r)

@@ -1,0 +1,304 @@
+"""Proxy role: transaction front door — read versions and the commit pipeline.
+
+Reference: fdbserver/MasterProxyServer.actor.cpp.
+
+commitBatch (:321) runs one actor per batch through 5 explicitly-phased steps,
+pipelined so batch N+1 resolves while batch N logs (the
+latestLocalCommitBatchResolving / latestLocalCommitBatchLogging gates at
+:364-366 and :426-428):
+
+  1 pre-resolution: order on (batch-1) resolving; get a commit version from
+    the master; split every txn's conflict ranges across resolvers by the
+    keyResolvers range map (ResolutionRequestBuilder :240-318)
+  2 resolution: release the resolving gate, wait all resolver replies (:420)
+  3 post-resolution: order on (batch-1) logging; committed = min over the
+    resolvers each txn touched (:492-504); substitute versionstamps; route
+    mutations to storage tags by the shard map (:578-716)
+  4 logging: push to TLogs, wait quorum (:835)
+  5 replies: advance committedVersion, answer each txn (:862-898)
+
+Read versions (GRV): transactionStarter (:985) batches requests and replies
+with the last committed version — strict serializability comes from commits
+being ordered, not from asking the master.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.notified import NotifiedVersion
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.ops.batch import (
+    COMMITTED, CONFLICT, TOO_OLD, TxnConflictInfo)
+from foundationdb_tpu.server.interfaces import (
+    CommitReply, CommitTransactionRequest, GetCommitVersionRequest,
+    GetReadVersionReply, GetReadVersionRequest,
+    ResolveTransactionBatchRequest, TLogCommitRequest, Token)
+from foundationdb_tpu.core.future import all_of
+from foundationdb_tpu.utils import keys as keylib
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.types import (
+    Mutation, MutationType, make_versionstamp, substitute_versionstamp)
+
+
+@dataclass
+class ShardMap:
+    """Key-range -> storage tag(s). Reference: the keyInfo range map the proxy
+    keeps from \\xff/keyServers (ApplyMetadataMutation.h). Static for now;
+    data distribution will mutate it transactionally later."""
+
+    boundaries: list[bytes]  # sorted; shard i = [boundaries[i], boundaries[i+1])
+    tags: list[list[int]]  # tags serving shard i (len = len(boundaries))
+
+    def tags_for_key(self, key: bytes) -> list[int]:
+        i = self._shard_of(key)
+        return self.tags[i]
+
+    def tags_for_range(self, begin: bytes, end: bytes) -> list[int]:
+        out: set[int] = set()
+        i = self._shard_of(begin)
+        while i < len(self.boundaries):
+            if i + 1 < len(self.boundaries) and self.boundaries[i + 1] <= begin:
+                i += 1
+                continue
+            if self.boundaries[i] >= end:
+                break
+            out.update(self.tags[i])
+            i += 1
+        return sorted(out)
+
+    def _shard_of(self, key: bytes) -> int:
+        return keylib.partition_index(self.boundaries, key)
+
+
+@dataclass
+class ResolverMap:
+    """Key-range -> resolver index (keyResolvers, MasterProxyServer:283-306)."""
+
+    boundaries: list[bytes]
+    endpoints: list[Endpoint]
+
+    def split_ranges(self, ranges: list[tuple[bytes, bytes]]) -> dict[int, list[tuple[bytes, bytes]]]:
+        """Partition conflict ranges among resolvers (clipped at boundaries)."""
+        out: dict[int, list[tuple[bytes, bytes]]] = {}
+        n = len(self.boundaries)
+        for b, e in ranges:
+            if not (b < e):
+                continue  # empty ranges conflict with nothing
+            i = keylib.partition_index(self.boundaries, b)
+            while i < n and self.boundaries[i] < e:
+                lo = max(b, self.boundaries[i])
+                hi = e if i + 1 >= n else min(e, self.boundaries[i + 1])
+                if lo < hi:
+                    out.setdefault(i, []).append((lo, hi))
+                i += 1
+        return out
+
+
+class Proxy:
+    def __init__(self, process: SimProcess, proxy_id: int, master: Endpoint,
+                 resolvers: ResolverMap, tlogs: list[Endpoint],
+                 shards: ShardMap, recovery_version: int = 0,
+                 other_proxies: list[str] | None = None):
+        self.process = process
+        self.loop = process.net.loop
+        self.proxy_id = proxy_id
+        self.master = master
+        self.resolvers = resolvers
+        self.tlogs = tlogs
+        self.shards = shards
+        self.other_proxies = [Endpoint(a, Token.PROXY_GET_COMMITTED_VERSION)
+                              for a in (other_proxies or [])]
+        self._request_num = 0
+        self._batch_n = 0
+        self.latest_resolving = NotifiedVersion(0)  # batch numbers
+        self.latest_logging = NotifiedVersion(0)
+        self.committed_version = NotifiedVersion(recovery_version)
+        self._pending: list[tuple[CommitTransactionRequest, object]] = []
+        self._batcher_armed = False
+        self.stats = {"commits_in": 0, "committed": 0, "conflicts": 0, "too_old": 0}
+        process.register(Token.PROXY_COMMIT, self._on_commit)
+        process.register(Token.PROXY_GET_READ_VERSION, self._on_grv)
+        process.register(Token.PROXY_GET_COMMITTED_VERSION,
+                         self._on_get_committed_version)
+
+    # -- GRV service --
+
+    def _on_get_committed_version(self, req, reply):
+        reply.send(self.committed_version.get())
+
+    def _on_grv(self, req: GetReadVersionRequest, reply):
+        if not self.other_proxies:
+            reply.send(GetReadVersionReply(version=self.committed_version.get()))
+            return
+        self.process.spawn(self._grv_confirm(reply), "getLiveCommittedVersion")
+
+    async def _grv_confirm(self, reply):
+        """getLiveCommittedVersion (:935): a correct read version is >= every
+        commit any proxy has acknowledged, so take the max over all proxies."""
+        try:
+            others = await all_of([
+                self.process.net.request(self.process, ep, None)
+                for ep in self.other_proxies])
+            version = max([self.committed_version.get()] + others)
+            reply.send(GetReadVersionReply(version=version))
+        except FDBError as e:
+            reply.send_error(e)
+
+    # -- commit batching (queueTransactionStartRequests/batcher pattern) --
+
+    def _on_commit(self, req: CommitTransactionRequest, reply):
+        self.stats["commits_in"] += 1
+        self._pending.append((req, reply))
+        if len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX:
+            self._flush()
+        elif not self._batcher_armed:
+            self._batcher_armed = True
+            self.process.spawn(self._batch_timer(), "commitBatcher")
+
+    async def _batch_timer(self):
+        await self.loop.delay(KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
+        self._batcher_armed = False
+        if self._pending:
+            self._flush()
+
+    def _flush(self):
+        batch, self._pending = self._pending, []
+        self._batch_n += 1
+        self.process.spawn(self._commit_batch(self._batch_n, batch), "commitBatch")
+
+    # -- the 5-phase pipeline --
+
+    async def _commit_batch(self, batch_n: int, batch):
+        requests = [req for req, _ in batch]
+        replies = [rep for _, rep in batch]
+        try:
+            # ---- Phase 1: pre-resolution (:363) ----
+            await self.latest_resolving.when_at_least(batch_n - 1)
+            self._request_num += 1
+            ver = await self.process.net.request(
+                self.process, self.master,
+                GetCommitVersionRequest(self.proxy_id, self._request_num))
+            commit_version, prev_version = ver.version, ver.prev_version
+
+            n_res = len(self.resolvers.endpoints)
+            # per-resolver transaction lists + mapping back (transactionResolverMap)
+            res_txns: list[list[TxnConflictInfo]] = [[] for _ in range(n_res)]
+            txn_resolver_slots: list[list[tuple[int, int]]] = []
+            for req in requests:
+                split_r = self.resolvers.split_ranges(req.read_conflict_ranges)
+                split_w = self.resolvers.split_ranges(req.write_conflict_ranges)
+                touched = sorted(set(split_r) | set(split_w)) or [0]
+                slots = []
+                for r in touched:
+                    slots.append((r, len(res_txns[r])))
+                    res_txns[r].append(TxnConflictInfo(
+                        read_snapshot=req.read_snapshot,
+                        read_ranges=split_r.get(r, []),
+                        write_ranges=split_w.get(r, [])))
+                txn_resolver_slots.append(slots)
+
+            resolve_futures = [
+                self.process.net.request(
+                    self.process, self.resolvers.endpoints[r],
+                    ResolveTransactionBatchRequest(
+                        prev_version=prev_version, version=commit_version,
+                        last_receive_version=prev_version,
+                        transactions=res_txns[r]))
+                for r in range(n_res)]
+
+            # ---- Phase 2: resolution (:419) ----
+            self.latest_resolving.set(batch_n)  # pipelining gate (:417)
+            resolutions = await all_of(resolve_futures)
+
+            # ---- Phase 3: post-resolution (:425) ----
+            await self.latest_logging.when_at_least(batch_n - 1)
+            statuses = []
+            for slots in txn_resolver_slots:
+                # committed iff every touched resolver says committed (:492-504)
+                s = min(resolutions[r].committed[i] for r, i in slots)
+                statuses.append(s)
+
+            messages: dict[int, list[Mutation]] = {}
+            batch_order = 0
+            for req, status in zip(requests, statuses):
+                if status != COMMITTED:
+                    continue
+                stamp = make_versionstamp(commit_version, batch_order)
+                batch_order += 1
+                for m in req.mutations:
+                    m = self._substitute(m, stamp)
+                    if m.type == MutationType.CLEAR_RANGE:
+                        tags = self.shards.tags_for_range(m.param1, m.param2)
+                    else:
+                        tags = self.shards.tags_for_key(m.param1)
+                    for t in tags:
+                        messages.setdefault(t, []).append(m)
+
+            # ---- Phase 4: logging (:835) ----
+            quorum = len(self.tlogs) - KNOBS.TLOG_QUORUM_ANTIQUORUM
+            log_futures = [
+                self.process.net.request(
+                    self.process, tl,
+                    TLogCommitRequest(
+                        prev_version=prev_version, version=commit_version,
+                        messages=messages,
+                        known_committed_version=self.committed_version.get()))
+                for tl in self.tlogs]
+            await self._wait_quorum(log_futures, quorum)
+            self.latest_logging.set(batch_n)
+
+            # ---- Phase 5: replies (:862) ----
+            if commit_version > self.committed_version.get():
+                self.committed_version.set(commit_version)
+            for rep, status in zip(replies, statuses):
+                if status == COMMITTED:
+                    self.stats["committed"] += 1
+                    rep.send(CommitReply(version=commit_version))
+                elif status == TOO_OLD:
+                    self.stats["too_old"] += 1
+                    rep.send_error(FDBError("transaction_too_old"))
+                else:
+                    self.stats["conflicts"] += 1
+                    rep.send_error(FDBError("not_committed"))
+        except Exception as e:  # noqa: BLE001
+            # a failed stage fails the whole batch; clients retry
+            # (commit_unknown_result semantics: the batch may have logged)
+            self.latest_resolving.set(max(self.latest_resolving.get(), batch_n))
+            self.latest_logging.set(max(self.latest_logging.get(), batch_n))
+            detail = getattr(e, "name", type(e).__name__)
+            for rep in replies:
+                if not rep.is_set():
+                    rep.send_error(FDBError("commit_unknown_result", detail))
+
+    def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:
+        if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+            return Mutation(MutationType.SET_VALUE,
+                            substitute_versionstamp(m.param1, stamp), m.param2)
+        if m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+            return Mutation(MutationType.SET_VALUE, m.param1,
+                            substitute_versionstamp(m.param2, stamp))
+        return m
+
+    async def _wait_quorum(self, futures, quorum: int):
+        if quorum >= len(futures):
+            await all_of(futures)
+            return
+        done = [0]
+        from foundationdb_tpu.core.future import Future
+        gate = Future()
+
+        def on_done(f):
+            if gate.is_ready():
+                return
+            if f.is_error():
+                gate._set_error(f._result)
+            else:
+                done[0] += 1
+                if done[0] >= quorum:
+                    gate._set(None)
+
+        for f in futures:
+            f.add_callback(on_done)
+        await gate

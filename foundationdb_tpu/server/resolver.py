@@ -1,0 +1,63 @@
+"""Resolver role: orders commit batches and runs the conflict engine.
+
+Reference: fdbserver/Resolver.actor.cpp — resolveBatch (:71): batches from all
+proxies are serialized per-resolver by waiting version.whenAtLeast(prevVersion)
+(:104-115), the ConflictBatch decides each transaction (:140-157), duplicate
+(retransmitted) batches get their cached reply (:117-128), and the reply
+carries one status per transaction (:159-166).
+
+The conflict engine is the knob-dispatched seam (ConflictSet.h:28): "device" =
+the JAX/TPU batched kernel (ops/conflict.py), "oracle" = the pure-Python CPU
+reference (ops/conflict_oracle.py). Both make identical decisions (tested).
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.notified import NotifiedVersion
+from foundationdb_tpu.core.sim import SimProcess
+from foundationdb_tpu.ops.conflict import DeviceConflictSet
+from foundationdb_tpu.ops.conflict_oracle import OracleConflictSet
+from foundationdb_tpu.server.interfaces import (
+    ResolveTransactionBatchReply, ResolveTransactionBatchRequest, Token)
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+def new_conflict_set(oldest_version: int = 0):
+    """newConflictSet() dispatch (ConflictSet.h:28) on the CONFLICT_BACKEND knob."""
+    if KNOBS.CONFLICT_BACKEND == "device":
+        return DeviceConflictSet(oldest_version=oldest_version)
+    return OracleConflictSet(oldest_version=oldest_version)
+
+
+class Resolver:
+    def __init__(self, process: SimProcess, recovery_version: int = 0):
+        self.process = process
+        self.version = NotifiedVersion(recovery_version)
+        self.conflict_set = new_conflict_set(oldest_version=recovery_version)
+        self._recent_replies: dict[int, ResolveTransactionBatchReply] = {}
+        self.total_resolved = 0
+        process.register(Token.RESOLVER_RESOLVE, self._on_resolve)
+
+    def _on_resolve(self, req: ResolveTransactionBatchRequest, reply):
+        self.process.spawn(self._resolve_batch(req, reply), "resolveBatch")
+
+    async def _resolve_batch(self, req: ResolveTransactionBatchRequest, reply):
+        await self.version.when_at_least(req.prev_version)
+        if req.version <= self.version.get():
+            cached = self._recent_replies.get(req.version)
+            if cached is not None:
+                reply.send(cached)
+            # unknown old version: a retransmit from before our recovery —
+            # drop; the proxy's own retry/recovery handles it
+            return
+        statuses = self.conflict_set.detect(req.transactions, req.version)
+        self.total_resolved += len(req.transactions)
+        r = ResolveTransactionBatchReply(committed=statuses)
+        self._recent_replies[req.version] = r
+        # prune the reply cache outside the MVCC window (reference prunes by
+        # oldest proxy version, Resolver.actor.cpp:198-224)
+        floor = req.version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+        for v in [v for v in self._recent_replies if v < floor]:
+            del self._recent_replies[v]
+        self.version.set(req.version)
+        reply.send(r)

@@ -1,0 +1,175 @@
+"""Storage server role: versioned reads over a TLog-fed MVCC window.
+
+Reference: fdbserver/storageserver.actor.cpp — the update loop (:2358) pulls
+this server's tag from the log system, applies mutations into VersionedData at
+each version, and wakes readers (waitForVersion :654). getValueQ (:707) and
+getKeyValues (:1210) serve reads at any version in the window;
+updateStorage (:2633) advances durability and pops the TLog; watches
+(watchValueQ :842) resolve when a key's value changes.
+
+KeySelector resolution happens server-side like the reference (a selector
+walks live keys from its base; offsets beyond the shard would chain to other
+servers — single-shard for now).
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.notified import NotifiedVersion
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.server.interfaces import (
+    GetKeyValuesReply, GetKeyValuesRequest, GetValueReply, GetValueRequest,
+    KeySelector, TLogPeekRequest, TLogPopRequest, Token, WatchValueRequest)
+from foundationdb_tpu.server.versioned_map import VersionedMap
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.types import MutationType
+
+
+class StorageServer:
+    def __init__(self, process: SimProcess, tag: int, tlog_addrs: list[str],
+                 recovery_version: int = 0):
+        """Peeks go to the first TLog; pops go to every TLog holding the tag
+        (each replica stores the tag, so each must be told to reclaim)."""
+        self.process = process
+        self.tag = tag
+        self._peek_ep = Endpoint(tlog_addrs[0], Token.TLOG_PEEK)
+        self._pop_eps = [Endpoint(a, Token.TLOG_POP) for a in tlog_addrs]
+        self.data = VersionedMap(oldest_version=recovery_version)
+        self.version = NotifiedVersion(recovery_version)  # latest applied
+        self.durable_version = recovery_version
+        self._watches: list[tuple[WatchValueRequest, object]] = []
+        process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
+        process.register(Token.STORAGE_GET_KEY_VALUES, self._on_get_key_values)
+        process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
+        self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
+
+    # -- ingestion (update :2358 + updateStorage :2633) --
+
+    async def _update_loop(self):
+        while True:
+            reply = await self.process.net.request(
+                self.process, self._peek_ep,
+                TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1))
+            for version, muts in reply.messages:
+                if version <= self.version.get():
+                    continue
+                for m in muts:
+                    self.data.apply(version, m)
+                self.version.set(version)
+                self._trigger_watches(version)
+            if reply.end - 1 > self.version.get():
+                # a gap can't happen with one tlog; guard for multi-log later
+                self.version.set(reply.end - 1)
+                self.data.latest_version = max(self.data.latest_version, reply.end - 1)
+                self._trigger_watches(reply.end - 1)
+            self._advance_durability()
+
+    def _advance_durability(self):
+        """Forget history outside the MVCC window and pop the TLog."""
+        target = self.version.get() - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS
+        if target > self.durable_version:
+            self.durable_version = target
+            self.data.forget_before(target)
+            for ep in self._pop_eps:
+                self.process.net.one_way(
+                    self.process, ep,
+                    TLogPopRequest(tag=self.tag, version=target))
+
+    # -- reads --
+
+    async def _wait_for_version(self, version: int) -> None:
+        """waitForVersion (:654): too-new reads wait (bounded), dead reads throw.
+
+        A catch-up timeout surfaces as retryable future_version (the reference
+        throws future_version after FUTURE_VERSION_DELAY), not timed_out.
+        """
+        if version > self.version.get() + KNOBS.MAX_VERSIONS_IN_FLIGHT:
+            raise FDBError("future_version")
+        if version > self.version.get():
+            loop = self.process.net.loop
+            try:
+                await loop.timeout(self.version.when_at_least(version), 5.0)
+            except FDBError as e:
+                if e.name == "timed_out":
+                    raise FDBError("future_version") from None
+                raise
+        if version < self.data.oldest_version:
+            raise FDBError("transaction_too_old")
+
+    def _on_get_value(self, req: GetValueRequest, reply):
+        self.process.spawn(self._get_value(req, reply), "getValueQ")
+
+    async def _get_value(self, req: GetValueRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+            reply.send(GetValueReply(value=self.data.get(req.key, req.version),
+                                     version=req.version))
+        except FDBError as e:
+            reply.send_error(e)
+
+    # selector resolution (storageserver.actor.cpp findKey)
+    def _resolve_selector(self, sel: KeySelector, version: int) -> bytes:
+        """Resolve to a live key (or b'' / \\xff end sentinels)."""
+        # forward: offset >= 1 means "offset-th live key at-or-after"
+        if sel.offset >= 1:
+            skip = sel.offset - 1
+            begin = sel.key + (b"\x00" if sel.or_equal else b"")
+            data, _ = self.data.range_read(begin, b"\xff" * 32, version,
+                                           limit=skip + 1)
+            if len(data) > skip:
+                return data[skip][0]
+            return b"\xff"  # past the end
+        # backward: offset <= 0 means "(1-offset)-th live key before"
+        skip = -sel.offset
+        end = sel.key + (b"\x00" if sel.or_equal else b"")
+        data, _ = self.data.range_read(b"", end, version, limit=skip + 1,
+                                       reverse=True)
+        if len(data) > skip:
+            return data[skip][0]
+        return b""
+
+    def _on_get_key_values(self, req: GetKeyValuesRequest, reply):
+        self.process.spawn(self._get_key_values(req, reply), "getKeyValues")
+
+    async def _get_key_values(self, req: GetKeyValuesRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+            begin = self._resolve_selector(req.begin, req.version)
+            end = self._resolve_selector(req.end, req.version)
+            if end < begin:
+                end = begin
+            limit_bytes = req.limit_bytes or KNOBS.DESIRED_TOTAL_BYTES
+            data, more = self.data.range_read(
+                begin, end, req.version, limit=req.limit,
+                limit_bytes=limit_bytes, reverse=req.reverse)
+            reply.send(GetKeyValuesReply(data=data, more=more, version=req.version))
+        except FDBError as e:
+            reply.send_error(e)
+
+    # -- watches (watchValueQ :842) --
+
+    def _on_watch(self, req: WatchValueRequest, reply):
+        self.process.spawn(self._watch(req, reply), "watchValue")
+
+    async def _watch(self, req: WatchValueRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+            current = self.data.get(req.key, self.version.get())
+            if current != req.value:
+                reply.send(self.version.get())
+                return
+            self._watches.append((req, reply))
+        except FDBError as e:
+            reply.send_error(e)
+
+    def _trigger_watches(self, version: int):
+        if not self._watches:
+            return
+        keep = []
+        for req, reply in self._watches:
+            current = self.data.get(req.key, version)
+            if current != req.value:
+                reply.send(version)
+            else:
+                keep.append((req, reply))
+        self._watches = keep

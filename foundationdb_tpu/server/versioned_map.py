@@ -1,0 +1,159 @@
+"""VersionedMap: the storage server's in-memory MVCC window.
+
+Reference: fdbclient/VersionedMap.h — a path-copying tree (PTree :43) serving
+reads at any version inside the ~5 s MVCC window, fed by the TLog cursor and
+pruned as versions become durable (storageserver.actor.cpp:2358 update,
+:2633 updateStorage).
+
+TPU-host design: instead of a persistent tree we keep, per key, an ascending
+version chain of (version, value-or-tombstone), plus one sorted key index for
+range reads. Mutations arrive strictly in version order (the TLog ingestion
+contract), so chain appends are O(1) amortized and a read at version v binary
+searches the chain. ClearRange writes tombstones onto every key live at that
+version (chains preserve older versions for concurrent readers).
+
+forget_before(v) drops chain prefixes older than v — the analogue of the
+PTree forgetting versions once durable.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator
+
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.types import (
+    ATOMIC_OPS, Mutation, MutationType, apply_atomic_op)
+
+
+class VersionedMap:
+    def __init__(self, oldest_version: int = 0):
+        self._index: list[bytes] = []  # sorted keys with non-empty chains
+        self._chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        self.oldest_version = oldest_version  # reads below this throw
+        self.latest_version = oldest_version
+
+    # -- write path (version order enforced by caller) --
+
+    def apply(self, version: int, m: Mutation):
+        if version < self.latest_version:
+            raise FDBError("internal_error",
+                           f"mutation at {version} < latest {self.latest_version}")
+        self.latest_version = version
+        if m.type == MutationType.SET_VALUE:
+            self._put(m.param1, version, m.param2)
+        elif m.type == MutationType.CLEAR_RANGE:
+            lo = bisect.bisect_left(self._index, m.param1)
+            hi = bisect.bisect_left(self._index, m.param2)
+            # slice copy: _put may drop fully-cleared keys from the index
+            for key in self._index[lo:hi]:
+                if self._latest_value(key) is not None:
+                    self._put(key, version, None)
+        elif m.type in ATOMIC_OPS:
+            existing = self._latest_value(m.param1)
+            self._put(m.param1, version, apply_atomic_op(m.type, existing, m.param2))
+        elif m.type == MutationType.NO_OP:
+            pass
+        else:
+            raise FDBError("invalid_mutation_type", str(m.type))
+
+    def _latest_value(self, key: bytes) -> bytes | None:
+        chain = self._chains.get(key)
+        return chain[-1][1] if chain else None
+
+    def _put(self, key: bytes, version: int, value: bytes | None):
+        chain = self._chains.get(key)
+        if chain is None:
+            if value is None:
+                return  # clearing an absent key is a no-op
+            self._chains[key] = [(version, value)]
+            bisect.insort(self._index, key)
+            return
+        if chain[-1][0] == version:
+            chain[-1] = (version, value)
+        else:
+            chain.append((version, value))
+
+    # -- read path --
+
+    def _value_at(self, key: bytes, version: int) -> bytes | None:
+        chain = self._chains.get(key)
+        if not chain:
+            return None
+        # rightmost entry with entry.version <= version
+        i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+        if i < 0:
+            return None
+        return chain[i][1]
+
+    def get(self, key: bytes, version: int) -> bytes | None:
+        self._check_version(version)
+        return self._value_at(key, version)
+
+    def range_read(self, begin: bytes, end: bytes, version: int,
+                   limit: int = 0, limit_bytes: int = 0,
+                   reverse: bool = False) -> tuple[list[tuple[bytes, bytes]], bool]:
+        """Live (key, value) pairs in [begin, end) at `version`.
+
+        Returns (data, more): `more` means a limit cut the scan short
+        (storageserver.actor.cpp readRange limit semantics).
+        """
+        self._check_version(version)
+        out: list[tuple[bytes, bytes]] = []
+        total = 0
+        it = self._iter_keys(begin, end, reverse)
+        for key in it:
+            v = self._value_at(key, version)
+            if v is None:
+                continue
+            out.append((key, v))
+            total += len(key) + len(v)
+            if (limit and len(out) >= limit) or (limit_bytes and total >= limit_bytes):
+                return out, self._has_live_after(it, version)
+        return out, False
+
+    def _has_live_after(self, it: Iterator[bytes], version: int) -> bool:
+        for key in it:
+            if self._value_at(key, version) is not None:
+                return True
+        return False
+
+    def _iter_keys(self, begin: bytes, end: bytes, reverse: bool) -> Iterator[bytes]:
+        lo = bisect.bisect_left(self._index, begin)
+        hi = bisect.bisect_left(self._index, end)
+        rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+        for i in rng:
+            yield self._index[i]
+
+    def _check_version(self, version: int):
+        if version < self.oldest_version:
+            raise FDBError("transaction_too_old",
+                           f"read at {version} < oldest {self.oldest_version}")
+
+    # -- GC (updateStorage analogue) --
+
+    def forget_before(self, version: int):
+        """Drop history below `version`; reads below it now throw."""
+        if version <= self.oldest_version:
+            return
+        self.oldest_version = version
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+            if i > 0:
+                del chain[:i]
+            if len(chain) == 1 and chain[0][1] is None:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._index, key)
+            del self._index[i]
+
+    # -- introspection --
+
+    def key_count(self) -> int:
+        return len(self._index)
+
+    def byte_size(self) -> int:
+        return sum(len(k) + sum(len(v or b"") + 16 for _, v in c)
+                   for k, c in self._chains.items())

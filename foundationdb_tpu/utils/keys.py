@@ -96,3 +96,11 @@ def strinc(key: bytes) -> bytes:
 def key_after(key: bytes) -> bytes:
     """Immediate successor in lexicographic order."""
     return key + b"\x00"
+
+
+def partition_index(boundaries: list[bytes], key: bytes) -> int:
+    """Index of the partition owning `key` for sorted begin-boundaries
+    (boundaries[0] == b""). Shared by shard maps, resolver maps, and the
+    client location cache so ownership can never diverge between them."""
+    import bisect
+    return max(0, bisect.bisect_right(boundaries, key) - 1)

@@ -1,0 +1,165 @@
+"""Unit tests for VersionedMap, atomic ops, and the WriteMap overlay.
+
+Reference test analogues: -r versionedmaptest (VersionedMap.h), the AtomicOps
+workload (fdbserver/workloads/AtomicOps.actor.cpp), and the WriteDuringRead /
+RyowCorrectness workloads for the overlay.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.writemap import WriteMap
+from foundationdb_tpu.server.versioned_map import VersionedMap
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.types import (
+    Mutation, MutationType, apply_atomic_op, make_versionstamp,
+    substitute_versionstamp)
+
+
+def S(k, v):
+    return Mutation(MutationType.SET_VALUE, k, v)
+
+
+def C(b, e):
+    return Mutation(MutationType.CLEAR_RANGE, b, e)
+
+
+class TestVersionedMap:
+    def test_versioned_reads(self):
+        m = VersionedMap()
+        m.apply(10, S(b"a", b"1"))
+        m.apply(20, S(b"a", b"2"))
+        m.apply(30, C(b"a", b"b"))
+        assert m.get(b"a", 5) is None
+        assert m.get(b"a", 10) == b"1"
+        assert m.get(b"a", 19) == b"1"
+        assert m.get(b"a", 20) == b"2"
+        assert m.get(b"a", 29) == b"2"
+        assert m.get(b"a", 30) is None
+
+    def test_clear_range_only_hides_from_clear_version(self):
+        m = VersionedMap()
+        for i, k in enumerate([b"a", b"b", b"c"]):
+            m.apply(10 + i, S(k, k.upper()))
+        m.apply(50, C(b"a", b"c"))
+        data, _ = m.range_read(b"", b"z", 49)
+        assert [k for k, _v in data] == [b"a", b"b", b"c"]
+        data, _ = m.range_read(b"", b"z", 50)
+        assert [k for k, _v in data] == [b"c"]
+
+    def test_key_set_after_clear_reappears(self):
+        m = VersionedMap()
+        m.apply(10, S(b"a", b"1"))
+        m.apply(20, C(b"a", b"b"))
+        m.apply(30, S(b"a", b"3"))
+        assert m.get(b"a", 20) is None
+        assert m.get(b"a", 30) == b"3"
+
+    def test_range_limits_and_more_flag(self):
+        m = VersionedMap()
+        for i in range(10):
+            m.apply(10 + i, S(b"k%d" % i, b"v"))
+        data, more = m.range_read(b"", b"z", 100, limit=3)
+        assert len(data) == 3 and more
+        data, more = m.range_read(b"", b"z", 100, limit=10)
+        assert len(data) == 10 and not more
+        data, more = m.range_read(b"", b"z", 100, reverse=True, limit=2)
+        assert [k for k, _ in data] == [b"k9", b"k8"] and more
+
+    def test_forget_before_gc(self):
+        m = VersionedMap()
+        m.apply(10, S(b"a", b"1"))
+        m.apply(20, S(b"a", b"2"))
+        m.apply(30, C(b"a", b"b"))
+        m.apply(40, S(b"b", b"x"))
+        m.forget_before(25)
+        with pytest.raises(FDBError):
+            m.get(b"a", 24)
+        assert m.get(b"a", 25) == b"2"
+        assert m.get(b"a", 35) is None
+        # fully-dead tombstoned keys are dropped once outside the window
+        m.forget_before(35)
+        assert m.get(b"a", 40) is None
+        assert m.key_count() == 1  # only b"b" remains
+
+    def test_atomic_in_map(self):
+        m = VersionedMap()
+        m.apply(10, Mutation(MutationType.ADD_VALUE, b"n", (3).to_bytes(4, "little")))
+        m.apply(20, Mutation(MutationType.ADD_VALUE, b"n", (4).to_bytes(4, "little")))
+        assert int.from_bytes(m.get(b"n", 20), "little") == 7
+        assert int.from_bytes(m.get(b"n", 10), "little") == 3
+
+
+class TestAtomicOps:
+    def test_add_wraps_and_pads(self):
+        assert apply_atomic_op(MutationType.ADD_VALUE, None, (5).to_bytes(4, "little")) \
+            == (5).to_bytes(4, "little")
+        assert apply_atomic_op(MutationType.ADD_VALUE, (0xFFFFFFFF).to_bytes(4, "little"),
+                               (1).to_bytes(4, "little")) == (0).to_bytes(4, "little")
+        # width follows the operand
+        assert apply_atomic_op(MutationType.ADD_VALUE, b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                               b"\x01\x00") == b"\x02\x00"
+
+    def test_bitwise(self):
+        assert apply_atomic_op(MutationType.AND, b"\x0f\xf0", b"\xff\x10") == b"\x0f\x10"
+        assert apply_atomic_op(MutationType.AND, None, b"\xff\xff") == b"\x00\x00"
+        assert apply_atomic_op(MutationType.OR, b"\x01", b"\x10") == b"\x11"
+        assert apply_atomic_op(MutationType.XOR, b"\xff", b"\x0f") == b"\xf0"
+
+    def test_min_max(self):
+        five, nine = (5).to_bytes(4, "little"), (9).to_bytes(4, "little")
+        assert apply_atomic_op(MutationType.MAX, five, nine) == nine
+        assert apply_atomic_op(MutationType.MAX, nine, five) == nine
+        assert apply_atomic_op(MutationType.MIN, nine, five) == five
+        assert apply_atomic_op(MutationType.MIN, None, five) == five  # v2
+        assert apply_atomic_op(MutationType.BYTE_MIN, b"abc", b"abd") == b"abc"
+        assert apply_atomic_op(MutationType.BYTE_MAX, b"abc", b"b") == b"b"
+
+    def test_append_if_fits(self):
+        assert apply_atomic_op(MutationType.APPEND_IF_FITS, b"ab", b"cd") == b"abcd"
+        big = b"x" * 99_999
+        assert apply_atomic_op(MutationType.APPEND_IF_FITS, big, b"yy") == big
+
+    def test_versionstamp(self):
+        stamp = make_versionstamp(0x1122334455667788, 3)
+        assert len(stamp) == 10
+        param = b"AA" + b"\x00" * 10 + b"BB" + (2).to_bytes(4, "little")
+        out = substitute_versionstamp(param, stamp)
+        assert out == b"AA" + stamp + b"BB"
+
+
+class TestWriteMap:
+    def test_set_clear_interleave(self):
+        w = WriteMap()
+        w.set(b"a", b"1")
+        w.clear_range(b"a", b"c")
+        has, p, cleared = w.lookup(b"a")
+        assert has and p.known and p.value is None
+        assert w.is_cleared(b"b")
+        w.set(b"b", b"2")
+        has, p, _ = w.lookup(b"b")
+        assert p.value == b"2"
+
+    def test_write_conflict_ranges_coalesce(self):
+        w = WriteMap()
+        w.set(b"a", b"1")
+        w.set(b"a\x00", b"2")
+        w.clear_range(b"m", b"p")
+        w.set(b"n", b"3")  # inside the clear
+        ranges = w.write_conflict_ranges()
+        assert (b"a", b"a\x00\x00") in ranges
+        assert (b"m", b"p") in ranges
+        assert len(ranges) == 2
+
+    def test_pending_atomic_resolution(self):
+        w = WriteMap()
+        w.atomic_op(MutationType.ADD_VALUE, b"n", (2).to_bytes(4, "little"))
+        w.atomic_op(MutationType.ADD_VALUE, b"n", (3).to_bytes(4, "little"))
+        _, p, _ = w.lookup(b"n")
+        assert not p.known
+        assert int.from_bytes(p.resolve((10).to_bytes(4, "little")), "little") == 15
+        # after a set, ops fold eagerly
+        w.set(b"n", (1).to_bytes(4, "little"))
+        w.atomic_op(MutationType.ADD_VALUE, b"n", (1).to_bytes(4, "little"))
+        _, p, _ = w.lookup(b"n")
+        assert p.known
+        assert int.from_bytes(p.value, "little") == 2
