@@ -1,0 +1,103 @@
+"""North-star benchmark: resolver conflict-detection throughput on device.
+
+Mirrors the reference's in-binary microbench skipListTest()
+(fdbserver/SkipList.cpp:1412-1502): batches of transactions each carrying one
+read range and one write range over a shared keyspace, processed in commit
+order; the metric is committed transactions per second through the conflict
+engine (the resolver's hot loop, Resolver.actor.cpp:153).
+
+Baseline: the reference ships no committed number for skipListTest (it prints
+Mtransactions/s at run time; BASELINE.md). Public figures for the CPU SkipList
+put it on the order of 1M txns/s on one core (the single-threaded resolver,
+SkipList.cpp:42 disables the parallel path); vs_baseline is computed against
+BASELINE_TXNS_PER_SEC = 1.0e6.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TXNS_PER_SEC = 1.0e6
+
+# skipListTest shape: 500 batches x 5000 ranges; here T txns/batch with one
+# read + one write range each.
+TXNS_PER_BATCH = 4096
+N_BATCHES = 100
+WARMUP_BATCHES = 10
+KEYSPACE = 2_000_000  # contended: repeated keys across batches
+PIPELINE_DEPTH = 8  # outstanding device batches (proxy-style pipelining)
+
+
+def _make_batches(seed: int = 0):
+    from foundationdb_tpu.ops.batch import TxnConflictInfo
+
+    rng = np.random.RandomState(seed)
+    batches = []
+    version = 1_000_000
+    for _ in range(N_BATCHES + WARMUP_BATCHES):
+        lo = rng.randint(0, KEYSPACE, size=TXNS_PER_BATCH)
+        span = rng.randint(1, 1000, size=TXNS_PER_BATCH)
+        wlo = rng.randint(0, KEYSPACE, size=TXNS_PER_BATCH)
+        wspan = rng.randint(1, 1000, size=TXNS_PER_BATCH)
+        stale = rng.randint(0, 2_000_000, size=TXNS_PER_BATCH)
+        txns = []
+        for t in range(TXNS_PER_BATCH):
+            rb = int(lo[t]).to_bytes(8, "big")
+            re = int(lo[t] + span[t]).to_bytes(8, "big")
+            wb = int(wlo[t]).to_bytes(8, "big")
+            we = int(wlo[t] + wspan[t]).to_bytes(8, "big")
+            txns.append(TxnConflictInfo(
+                read_snapshot=version - int(stale[t]) % 900_000,
+                read_ranges=[(rb, re)],
+                write_ranges=[(wb, we)],
+            ))
+        batches.append((txns, version))
+        version += 10_000
+    return batches
+
+
+def main():
+    from foundationdb_tpu.ops.batch import COMMITTED
+    from foundationdb_tpu.ops.conflict import DeviceConflictSet
+
+    cs = DeviceConflictSet(
+        capacity=1 << 15, txns=TXNS_PER_BATCH,
+        reads_per_txn=1, writes_per_txn=1)
+    batches = _make_batches()
+
+    committed = 0
+    for txns, version in batches[:WARMUP_BATCHES]:
+        cs.detect(txns, version)
+
+    from collections import deque
+    t0 = time.perf_counter()
+    total = 0
+    pending: deque = deque()
+    for txns, version in batches[WARMUP_BATCHES:]:
+        pending.append(cs.detect_async(txns, version))
+        if len(pending) >= PIPELINE_DEPTH:
+            statuses = pending.popleft().result()
+            total += len(statuses)
+            committed += sum(1 for s in statuses if s == COMMITTED)
+    while pending:
+        statuses = pending.popleft().result()
+        total += len(statuses)
+        committed += sum(1 for s in statuses if s == COMMITTED)
+    dt = time.perf_counter() - t0
+
+    txns_per_sec = total / dt
+    print(json.dumps({
+        "metric": "resolver_conflict_txns_per_sec",
+        "value": round(txns_per_sec, 1),
+        "unit": "txns/s",
+        "vs_baseline": round(txns_per_sec / BASELINE_TXNS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,12 @@ class SimFile:
     def read_all(self) -> bytes:
         return self.durable + b"".join(self.pending)
 
+    def truncate(self):
+        """Discard all contents (durable and pending) — used by DiskQueue
+        file alternation; the truncate itself is treated as durable."""
+        self.durable = b""
+        self.pending.clear()
+
     def on_kill(self):
         """Each unsynced append independently survives or is lost; a lost
         prefix truncates everything after it (append-only log semantics)."""

@@ -1,0 +1,70 @@
+"""Native (C) host hot paths, compiled on first use.
+
+`fdb_native.c` provides bulk key→limb encoding and CRC-32C (see the C file
+header for the reference mapping). The extension is built on demand with the
+system compiler into this package directory; if no compiler is available the
+callers fall back to the pure-Python paths, so the framework still works —
+just slower on the host feed path.
+
+Usage:
+    from foundationdb_tpu import native
+    if native.available():
+        native.mod.encode_keys_into(keys, buf, round_up)
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fdb_native.c")
+_SO = os.path.join(_DIR, "fdb_native.so")
+
+mod = None
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    """Compile the extension; returns an error string or None."""
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", _SO]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"{type(e).__name__}: {e}"
+    if proc.returncode != 0:
+        return proc.stderr[-2000:]
+    return None
+
+
+def _load():
+    global mod, _build_error
+    if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+        _build_error = _build()
+        if _build_error is not None:
+            return
+    spec = importlib.util.spec_from_file_location("fdb_native", _SO)
+    m = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(m)
+    except ImportError as e:
+        _build_error = str(e)
+        return
+    mod = m
+
+
+_load()
+
+
+def available() -> bool:
+    return mod is not None
+
+
+def build_error() -> str | None:
+    return _build_error

@@ -1,0 +1,159 @@
+/* Native host hot paths for the TPU framework.
+ *
+ * The reference implements its hot host-side loops in C++ (the conflict
+ * engine's key juggling in fdbserver/SkipList.cpp, CRC32c in
+ * fdbrpc/crc32c.cpp, serialization in flow/serialize.h). The device replaces
+ * the conflict algorithms, but feeding the device still requires encoding
+ * arbitrary-length byte keys into fixed-width uint32 limb arrays at millions
+ * of keys/sec — far beyond what per-key Python can do. This module provides:
+ *
+ *   encode_keys_into(keys, out_buffer, n, round_up_mask)
+ *       bulk key -> limb encoding (layout matches utils/keys.py: KEY_BYTES
+ *       prefix as big-endian u32 limbs + one length limb, SoA (L, N))
+ *   crc32c(data, init) -> int
+ *       CRC-32C (Castagnoli), the checksum the reference uses for packets
+ *       and disk pages (fdbrpc/crc32c.cpp) — software slice-by-8 here.
+ *
+ * Built as a plain CPython extension (no pybind11/numpy headers; buffers via
+ * the buffer protocol) so it compiles anywhere with a C compiler.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define KEY_BYTES 24
+#define NUM_LIMBS (KEY_BYTES / 4 + 1)
+
+/* ------------------------------------------------------------------ */
+/* CRC-32C, slice-by-8                                                 */
+/* ------------------------------------------------------------------ */
+
+static uint32_t crc32c_table[8][256];
+static int crc32c_ready = 0;
+
+static void crc32c_init(void) {
+    uint32_t poly = 0x82F63B78u; /* reversed Castagnoli */
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = (uint32_t)i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+        crc32c_table[0][i] = c;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = crc32c_table[0][i];
+        for (int t = 1; t < 8; t++) {
+            c = crc32c_table[0][c & 0xFF] ^ (c >> 8);
+            crc32c_table[t][i] = c;
+        }
+    }
+    crc32c_ready = 1;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t *buf, Py_ssize_t len) {
+    crc = ~crc;
+    while (len >= 8) {
+        uint32_t lo, hi;
+        memcpy(&lo, buf, 4);
+        memcpy(&hi, buf + 4, 4);
+        lo ^= crc;
+        crc = crc32c_table[7][lo & 0xFF] ^
+              crc32c_table[6][(lo >> 8) & 0xFF] ^
+              crc32c_table[5][(lo >> 16) & 0xFF] ^
+              crc32c_table[4][lo >> 24] ^
+              crc32c_table[3][hi & 0xFF] ^
+              crc32c_table[2][(hi >> 8) & 0xFF] ^
+              crc32c_table[1][(hi >> 16) & 0xFF] ^
+              crc32c_table[0][hi >> 24];
+        buf += 8;
+        len -= 8;
+    }
+    while (len-- > 0)
+        crc = crc32c_table[0][(crc ^ *buf++) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+static PyObject *py_crc32c(PyObject *self, PyObject *args) {
+    Py_buffer data;
+    unsigned int init = 0;
+    if (!PyArg_ParseTuple(args, "y*|I", &data, &init))
+        return NULL;
+    uint32_t crc = crc32c_sw(init, (const uint8_t *)data.buf, data.len);
+    PyBuffer_Release(&data);
+    return PyLong_FromUnsignedLong(crc);
+}
+
+/* ------------------------------------------------------------------ */
+/* Bulk key encoding                                                   */
+/* ------------------------------------------------------------------ */
+
+/* encode_keys_into(keys: sequence of bytes, out: writable buffer of
+ * uint32[NUM_LIMBS * n] in SoA layout (limb-major), round_up: bool)
+ * Mirrors utils/keys.py encode_key exactly. */
+static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
+    PyObject *keys;
+    Py_buffer out;
+    int round_up = 0;
+    if (!PyArg_ParseTuple(args, "Ow*|p", &keys, &out, &round_up))
+        return NULL;
+
+    PyObject *seq = PySequence_Fast(keys, "keys must be a sequence");
+    if (!seq) {
+        PyBuffer_Release(&out);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if ((Py_ssize_t)(out.len) < (Py_ssize_t)(NUM_LIMBS * n * 4)) {
+        PyBuffer_Release(&out);
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "output buffer too small");
+        return NULL;
+    }
+    uint32_t *o = (uint32_t *)out.buf;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        char *kbuf;
+        Py_ssize_t klen;
+        if (PyBytes_AsStringAndSize(item, &kbuf, &klen) < 0) {
+            PyBuffer_Release(&out);
+            Py_DECREF(seq);
+            return NULL;
+        }
+        uint8_t padded[KEY_BYTES];
+        Py_ssize_t use = klen < KEY_BYTES ? klen : KEY_BYTES;
+        memcpy(padded, kbuf, use);
+        memset(padded + use, 0, KEY_BYTES - use);
+        for (int l = 0; l < NUM_LIMBS - 1; l++) {
+            const uint8_t *p = padded + 4 * l;
+            o[(Py_ssize_t)l * n + i] =
+                ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+        }
+        uint32_t lenlimb;
+        if (klen > KEY_BYTES)
+            lenlimb = round_up ? (KEY_BYTES + 1) : KEY_BYTES;
+        else
+            lenlimb = (uint32_t)klen;
+        o[(Py_ssize_t)(NUM_LIMBS - 1) * n + i] = lenlimb;
+    }
+    PyBuffer_Release(&out);
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"crc32c", py_crc32c, METH_VARARGS,
+     "crc32c(data, init=0) -> CRC-32C checksum"},
+    {"encode_keys_into", py_encode_keys_into, METH_VARARGS,
+     "encode_keys_into(keys, out_u32_buffer, round_up=False)"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "fdb_native", NULL, -1, methods};
+
+PyMODINIT_FUNC PyInit_fdb_native(void) {
+    crc32c_init();
+    return PyModule_Create(&moduledef);
+}

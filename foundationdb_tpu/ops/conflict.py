@@ -56,6 +56,23 @@ NEG = jnp.int32(-(1 << 30))  # "no version" sentinel, below any clamped offset
 _REBASE_THRESHOLD = 1 << 29
 
 
+def _bulk_encode(keys: list[bytes], out: np.ndarray, *, round_up: bool):
+    """Encode keys into out[:, :len(keys)] (SoA limbs), C path if built."""
+    if not keys:
+        return
+    from foundationdb_tpu import native
+
+    if native.available():
+        tmp = np.empty((L, len(keys)), dtype=np.uint32)
+        native.mod.encode_keys_into(keys, tmp, round_up)
+        out[:, : len(keys)] = tmp
+    else:
+        buf = np.zeros(L, dtype=np.uint32)
+        for i, k in enumerate(keys):
+            keylib.encode_key(k, buf, round_up=round_up)
+            out[:, i] = buf
+
+
 # ---------------------------------------------------------------------------
 # multi-limb key comparisons (vectorized lexicographic)
 # ---------------------------------------------------------------------------
@@ -402,33 +419,46 @@ class DeviceConflictSet:
     # -- encoding --
     def _encode_batch(self, txns: list[TxnConflictInfo], commit_version: int,
                       skip: list[bool] | None = None):
+        """Build one device batch. Key encoding is bulk (C extension when
+        available — feeding the device is a host hot path, the analogue of
+        the reference's C++ key juggling in SkipList.cpp addTransaction)."""
         sh = self.shapes
         T = sh.txns
         assert len(txns) <= T
-        rb = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
-        re = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
-        wb = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
-        we = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
-        rtxn = np.full(sh.reads, T, np.int32)
-        wtxn = np.full(sh.writes, T, np.int32)
+        rkeys_b: list[bytes] = []
+        rkeys_e: list[bytes] = []
+        wkeys_b: list[bytes] = []
+        wkeys_e: list[bytes] = []
+        rt: list[int] = []
+        wt: list[int] = []
         snap = np.zeros(T, np.int32)
         valid = np.zeros(T, bool)
-        ri = wi = 0
         for t, txn in enumerate(txns):
             if skip is not None and skip[t]:
                 continue  # host already decided TOO_OLD; not in this batch
             valid[t] = True
             snap[t] = self._clamp_off(txn.read_snapshot)
             for b, e in txn.read_ranges:
-                rb[:, ri] = keylib.encode_key(b)
-                re[:, ri] = keylib.encode_key(e, round_up=True)
-                rtxn[ri] = t
-                ri += 1
+                rkeys_b.append(b)
+                rkeys_e.append(e)
+                rt.append(t)
             for b, e in txn.write_ranges:
-                wb[:, wi] = keylib.encode_key(b)
-                we[:, wi] = keylib.encode_key(e, round_up=True)
-                wtxn[wi] = t
-                wi += 1
+                wkeys_b.append(b)
+                wkeys_e.append(e)
+                wt.append(t)
+
+        rb = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
+        re = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
+        wb = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
+        we = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
+        _bulk_encode(rkeys_b, rb, round_up=False)
+        _bulk_encode(rkeys_e, re, round_up=True)
+        _bulk_encode(wkeys_b, wb, round_up=False)
+        _bulk_encode(wkeys_e, we, round_up=True)
+        rtxn = np.full(sh.reads, T, np.int32)
+        wtxn = np.full(sh.writes, T, np.int32)
+        rtxn[: len(rt)] = rt
+        wtxn[: len(wt)] = wt
         return {
             "rb": jnp.asarray(rb), "re": jnp.asarray(re), "rtxn": jnp.asarray(rtxn),
             "wb": jnp.asarray(wb), "we": jnp.asarray(we), "wtxn": jnp.asarray(wtxn),
@@ -451,13 +481,24 @@ class DeviceConflictSet:
 
     # -- ConflictBatch interface --
     def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
+        return self.detect_async(txns, commit_version).result()
+
+    def detect_async(self, txns: list[TxnConflictInfo],
+                     commit_version: int) -> "DetectHandle":
+        """Enqueue the whole logical batch on device and return a handle;
+        no host↔device synchronization happens until handle.result().
+
+        This is the proxy's pipelining pattern (MasterProxyServer.actor.cpp
+        :364-366,426-428): batch N+1's transfer/compute overlaps batch N's
+        result readback.
+        """
         self._maybe_rebase(commit_version)
-        out: list[int] = []
         subs = self._split_for_capacity(txns)
         # The too-old decision is taken here with exact int64 versions (device
         # offsets saturate across extreme rebases); flagged txns are excluded
         # from the device batch entirely.
         pre_batch_oldest = self.oldest_version
+        chunks = []
         for i, sub in enumerate(subs):
             host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
                             for t in sub]
@@ -466,20 +507,14 @@ class DeviceConflictSet:
             # every chunk's too-old check uses the pre-batch floor
             batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
             new_state, statuses, info = self._step(self._state, batch)
-            if bool(info["overflow"]):
-                # do NOT install the truncated state — truncation drops the
-                # highest-key history segments and would cause false commits.
-                # Chunks before this one are already merged; the owner must
-                # treat this as fatal and reconstruct (clearConflictSet
-                # semantics: conflict state is soft, SkipList.cpp:957).
-                raise FDBError("internal_error",
-                               "conflict state capacity exceeded; raise CONFLICT_STATE_CAPACITY")
             self._state = new_state
-            dev_statuses = np.asarray(statuses[:len(sub)])
-            out.extend(TOO_OLD if old else int(s)
-                       for s, old in zip(dev_statuses, host_too_old))
-        self.oldest_version = self.base_version + int(self._state["oldest"])
-        return out
+            chunks.append((len(sub), host_too_old, statuses, info))
+        # the kernel's floor advance is replicated host-side exactly
+        # (floor = commit_version - window on the last chunk, monotonic max)
+        self.oldest_version = max(
+            self.oldest_version,
+            commit_version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        return DetectHandle(chunks)
 
     def _split_for_capacity(self, txns):
         sh = self.shapes
@@ -503,3 +538,30 @@ class DeviceConflictSet:
         self.base_version = oldest_version
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)
+
+
+class DetectHandle:
+    """Deferred result of detect_async: statuses fetched on first result()."""
+
+    def __init__(self, chunks):
+        self._chunks = chunks
+        self._result: list[int] | None = None
+
+    def result(self) -> list[int]:
+        if self._result is None:
+            out: list[int] = []
+            for n, host_too_old, statuses, info in self._chunks:
+                if bool(info["overflow"]):
+                    # The truncated state dropped the highest-key history
+                    # segments and could cause false commits — fatal; the
+                    # owner reconstructs (clearConflictSet semantics,
+                    # SkipList.cpp:957: conflict state is soft).
+                    raise FDBError(
+                        "internal_error",
+                        "conflict state capacity exceeded; raise CONFLICT_STATE_CAPACITY")
+                dev_statuses = np.asarray(statuses[:n])
+                out.extend(TOO_OLD if old else int(s)
+                           for s, old in zip(dev_statuses, host_too_old))
+            self._result = out
+            self._chunks = None
+        return self._result

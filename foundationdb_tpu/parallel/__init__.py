@@ -1,0 +1,21 @@
+"""Multi-device parallelism: mesh construction and the sharded conflict engine.
+
+The reference scales conflict detection by partitioning the keyspace across
+resolver processes (SURVEY.md §2.0; fdbserver/MasterProxyServer.actor.cpp:283-306
+fan-out, masterserver.actor.cpp:955 resolutionBalancing). Here the same strategy
+is a mesh axis: the conflict-set step function is sharded by key range over
+devices, each device checks/merges only ranges clipped to its shard, and the
+per-transaction verdicts combine with a min-collective — exactly the proxy's
+"min over resolvers touched" rule (MasterProxyServer.actor.cpp:492-504).
+"""
+
+from foundationdb_tpu.parallel.sharded_conflict import (
+    ShardedDeviceConflictSet, make_resolver_mesh, shard_cut_keys,
+    sharded_conflict_step)
+
+__all__ = [
+    "ShardedDeviceConflictSet",
+    "make_resolver_mesh",
+    "shard_cut_keys",
+    "sharded_conflict_step",
+]

@@ -72,6 +72,20 @@ class SimCluster:
                           tlog_addrs=tlog_addrs[i % n_tlogs:] + tlog_addrs[:i % n_tlogs])
             for i, p in enumerate(self.storage_procs)]
 
+        # reboot wiring: a rebooted process re-runs its role on surviving
+        # durable files (simulatedFDBDRebooter, SimulatedCluster.actor.cpp:198)
+        for i, proc in enumerate(self.storage_procs):
+            def boot_storage(p, i=i, n=n_tlogs):
+                addrs = tlog_addrs[i % n:] + tlog_addrs[:i % n]
+                self.storages[i] = StorageServer(p, tag=i, tlog_addrs=addrs)
+            proc.boot_fn = boot_storage
+        for i, proc in enumerate(self.tlog_procs):
+            def boot_tlog(p, i=i):
+                t = TLog(p)
+                t.recover_from_file()
+                self.tlogs[i] = t
+            proc.boot_fn = boot_tlog
+
         self.proxies = [
             Proxy(p, proxy_id=i, master=master_ep, resolvers=resolver_map,
                   tlogs=tlog_eps, shards=shard_map,

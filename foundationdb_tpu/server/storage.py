@@ -14,29 +14,52 @@ servers — single-shard for now).
 
 from __future__ import annotations
 
+from collections import deque
+
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
     GetKeyValuesReply, GetKeyValuesRequest, GetValueReply, GetValueRequest,
     KeySelector, TLogPeekRequest, TLogPopRequest, Token, WatchValueRequest)
 from foundationdb_tpu.server.versioned_map import VersionedMap
+from foundationdb_tpu.storage.kvstore import MemoryKeyValueStore
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
-from foundationdb_tpu.utils.types import MutationType
+from foundationdb_tpu.utils.types import Mutation, MutationType
+
+_DURABLE_VERSION_KEY = "durableVersion"
 
 
 class StorageServer:
     def __init__(self, process: SimProcess, tag: int, tlog_addrs: list[str],
                  recovery_version: int = 0):
         """Peeks go to the first TLog; pops go to every TLog holding the tag
-        (each replica stores the tag, so each must be told to reclaim)."""
+        (each replica stores the tag, so each must be told to reclaim).
+
+        Durability (updateStorage :2633 + restoreDurableState :2871): every
+        mutation leaving the MVCC window is applied to a durable KV engine
+        before the TLog is popped; on reboot the engine's contents seed the
+        versioned map at the persisted durable version and the TLog is
+        re-pulled from there.
+        """
         self.process = process
         self.tag = tag
         self._peek_ep = Endpoint(tlog_addrs[0], Token.TLOG_PEEK)
         self._pop_eps = [Endpoint(a, Token.TLOG_POP) for a in tlog_addrs]
-        self.data = VersionedMap(oldest_version=recovery_version)
-        self.version = NotifiedVersion(recovery_version)  # latest applied
-        self.durable_version = recovery_version
+        self.store = MemoryKeyValueStore(
+            process.net.open_file(process, f"storage-{tag}.0"),
+            process.net.open_file(process, f"storage-{tag}.1"))
+        self.store.recover()
+        meta = self.store.get_metadata(_DURABLE_VERSION_KEY)
+        self.durable_version = max(
+            recovery_version, int(meta.decode()) if meta else 0)
+        self.data = VersionedMap(oldest_version=self.durable_version)
+        for k, v in self.store.get_range(b"", b"\xff" * 32):
+            self.data.apply(self.durable_version,
+                            Mutation(MutationType.SET_VALUE, k, v))
+        self.data.oldest_version = self.durable_version
+        self.version = NotifiedVersion(self.durable_version)  # latest applied
+        self._pending_durable: deque[tuple[int, list]] = deque()
         self._watches: list[tuple[WatchValueRequest, object]] = []
         process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
         process.register(Token.STORAGE_GET_KEY_VALUES, self._on_get_key_values)
@@ -47,14 +70,21 @@ class StorageServer:
 
     async def _update_loop(self):
         while True:
-            reply = await self.process.net.request(
-                self.process, self._peek_ep,
-                TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1))
+            try:
+                reply = await self.process.net.request(
+                    self.process, self._peek_ep,
+                    TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1))
+            except FDBError:
+                # TLog dead/rebooting: back off and re-peek (the reference's
+                # peek cursor reconnects through the log system config)
+                await self.process.net.loop.delay(0.5)
+                continue
             for version, muts in reply.messages:
                 if version <= self.version.get():
                     continue
                 for m in muts:
                     self.data.apply(version, m)
+                self._pending_durable.append((version, muts))
                 self.version.set(version)
                 self._trigger_watches(version)
             if reply.end - 1 > self.version.get():
@@ -65,15 +95,36 @@ class StorageServer:
             self._advance_durability()
 
     def _advance_durability(self):
-        """Forget history outside the MVCC window and pop the TLog."""
+        """updateStorage (:2633): write mutations leaving the MVCC window to
+        the durable engine, commit, then forget them from memory and pop the
+        TLog — pop strictly after the engine commit, so a crash between the
+        two only re-applies (idempotent) mutations."""
         target = self.version.get() - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS
-        if target > self.durable_version:
-            self.durable_version = target
-            self.data.forget_before(target)
-            for ep in self._pop_eps:
-                self.process.net.one_way(
-                    self.process, ep,
-                    TLogPopRequest(tag=self.tag, version=target))
+        if target <= self.durable_version:
+            return
+        while self._pending_durable and self._pending_durable[0][0] <= target:
+            _v, muts = self._pending_durable.popleft()
+            for m in muts:
+                self._apply_durable(m)
+        self.durable_version = target
+        self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
+        self.store.commit()
+        self.data.forget_before(target)
+        for ep in self._pop_eps:
+            self.process.net.one_way(
+                self.process, ep,
+                TLogPopRequest(tag=self.tag, version=target))
+
+    def _apply_durable(self, m):
+        from foundationdb_tpu.utils.types import ATOMIC_OPS, apply_atomic_op
+        if m.type == MutationType.SET_VALUE:
+            self.store.set(m.param1, m.param2)
+        elif m.type == MutationType.CLEAR_RANGE:
+            self.store.clear_range(m.param1, m.param2)
+        elif m.type in ATOMIC_OPS:
+            self.store.set(m.param1,
+                           apply_atomic_op(m.type, self.store.get(m.param1),
+                                           m.param2))
 
     # -- reads --
 

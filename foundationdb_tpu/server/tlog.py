@@ -6,14 +6,15 @@ makes them durable (DiskQueue push/commit), and replies when durable; peeks
 serve per-tag cursors; pops advance the durable point so memory can be
 reclaimed (:362 version/queueCommittedVersion).
 
-Durability in the simulator uses a SimFile (append + sync): a kill loses
-unsynced appends exactly like AsyncFileNonDurable, so recovery tests mean
-something. Spill-to-kvstore arrives with the durability milestone.
+Durability: a DiskQueue (two alternating checksummed SimFiles,
+storage/diskqueue.py = DiskQueue.actor.cpp) — a kill loses unsynced pages
+exactly like AsyncFileNonDurable, so recovery tests mean something. Popped
+versions let the queue truncate (space reclaim). Spill-to-kvstore for
+long-lagging tags is still TODO.
 """
 
 from __future__ import annotations
 
-import io
 import pickle
 from collections import deque
 
@@ -22,6 +23,7 @@ from foundationdb_tpu.core.sim import SimProcess
 from foundationdb_tpu.server.interfaces import (
     TLogCommitReply, TLogCommitRequest, TLogPeekReply, TLogPeekRequest,
     TLogPopRequest, Token)
+from foundationdb_tpu.storage.diskqueue import DiskQueue
 
 
 class TLog:
@@ -32,7 +34,9 @@ class TLog:
         self.messages: dict[int, deque] = {}  # tag -> deque[(version, [Mutation])]
         self.popped: dict[int, int] = {}  # tag -> pop floor
         self.known_committed_version = recovery_version
-        self.file = process.net.open_file(process, file_name)
+        self.queue = DiskQueue(process.net.open_file(process, file_name + ".0"),
+                               process.net.open_file(process, file_name + ".1"))
+        self._version_seq: deque[tuple[int, int]] = deque()  # (version, seq)
         process.register(Token.TLOG_COMMIT, self._on_commit)
         process.register(Token.TLOG_PEEK, self._on_peek)
         process.register(Token.TLOG_POP, self._on_pop)
@@ -50,9 +54,10 @@ class TLog:
                 self.messages.setdefault(tag, deque()).append((req.version, muts))
         self.known_committed_version = max(self.known_committed_version,
                                            req.known_committed_version)
-        # durable append + sync, then reply (group commit = one sync per batch)
-        self.file.append(pickle.dumps((req.version, req.messages)))
-        self.file.sync()
+        # durable push + commit, then reply (group commit = one sync per batch)
+        seq = self.queue.push(pickle.dumps((req.version, req.messages)))
+        self.queue.commit()
+        self._version_seq.append((req.version, seq))
         self.version.set(req.version)
         reply.send(TLogCommitReply(version=req.version))
 
@@ -73,23 +78,33 @@ class TLog:
         q = self.messages.get(req.tag)
         while q and q[0][0] < req.version:
             q.popleft()
+        self._reclaim()
         reply.send(None)
 
+    def _reclaim(self):
+        """Truncate the disk queue below the min pop floor across tags
+        (TLogServer updatePersistentData: the queue is popped once every
+        tag has advanced past a version)."""
+        tags = set(self.messages) | set(self.popped)
+        if not tags or not self._version_seq:
+            return
+        floor = min(self.popped.get(t, 0) for t in tags)
+        upto_seq = None
+        while self._version_seq and self._version_seq[0][0] < floor:
+            upto_seq = self._version_seq.popleft()[1] + 1
+        if upto_seq is not None:
+            self.queue.pop(upto_seq)
+
     def recover_from_file(self):
-        """Rebuild in-memory deques from the durable file after a reboot."""
-        buf = io.BytesIO(self.file.read_all())
+        """Rebuild in-memory deques from the durable queue after a reboot."""
         last = self.version.get()
-        while True:
-            try:
-                version, messages = pickle.load(buf)
-            except EOFError:
-                break
-            if version <= last:
-                continue
+        for seq, payload in self.queue.recover():
+            version, messages = pickle.loads(payload)
+            self._version_seq.append((version, seq))
             for tag, muts in messages.items():
                 if muts:
                     self.messages.setdefault(tag, deque()).append((version, muts))
-            last = version
+            last = max(last, version)
         if last > self.version.get():
             self.version.set(last)
         return last

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+# A PJRT plugin registered at interpreter start (sitecustomize) may have set
+# jax_platforms programmatically, which overrides the env var — force CPU
+# before any backend initialization so the 8-device mesh is real.
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compile cache: the conflict-engine program is compiled once per
 # (shapes, window) and reused across test runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/fdb_tpu_jax_cache")

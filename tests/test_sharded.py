@@ -1,0 +1,155 @@
+"""Sharded conflict engine: verdicts on an 8-device mesh vs single-device/oracle.
+
+The combine rule (min over shards, MasterProxyServer.actor.cpp:492-504) plus
+per-shard write retention can only create false conflicts, never false
+commits — so the invariant tested is:
+
+  1. On workloads where every committed verdict is consistent across shards
+     (which is all of them: clipping preserves overlap structure exactly,
+     since a read range and a write range overlap iff they overlap within at
+     least one shard), sharded verdicts == single-device verdicts.
+  2. Write history is exactly partitioned: re-checking a read against the
+     sharded state gives the same answer as the unsharded state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops.batch import COMMITTED, CONFLICT, TxnConflictInfo
+from foundationdb_tpu.ops.conflict import DeviceConflictSet
+from foundationdb_tpu.ops.conflict_oracle import OracleConflictSet
+from foundationdb_tpu.parallel.sharded_conflict import (
+    ShardedDeviceConflictSet, make_resolver_mesh, shard_cut_keys)
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+def _random_batches(seed, n_batches, txns_per_batch, key_space=200, max_len=3):
+    rng = DeterministicRandom(seed)
+
+    def rkey():
+        n = rng.randint(1, max_len + 1)
+        return bytes(rng.randint(0, key_space) % 256 for _ in range(n))
+
+    def rrange():
+        a, b = sorted([rkey(), rkey()])
+        if a == b:
+            b = a + b"\x00"
+        return (a, b)
+
+    batches = []
+    version = 100
+    for _ in range(n_batches):
+        txns = []
+        for _ in range(txns_per_batch):
+            snap = version - rng.randint(0, 50)
+            txns.append(TxnConflictInfo(
+                read_snapshot=snap,
+                read_ranges=[rrange() for _ in range(rng.randint(0, 3))],
+                write_ranges=[rrange() for _ in range(rng.randint(0, 3))],
+            ))
+        batches.append((txns, version))
+        version += rng.randint(1, 30)
+    return batches
+
+
+def test_shard_cut_keys_shape():
+    cuts = shard_cut_keys(8)
+    assert cuts.shape[0] == 9
+    assert cuts[0].sum() == 0
+    assert (cuts[8] == 0xFFFFFFFF).all()
+    # strictly increasing first limbs
+    assert (np.diff(cuts[:, 0].astype(np.uint64)) > 0)[: 7].all()
+
+
+def _clip(rng_pair, lo, hi):
+    b, e = rng_pair
+    b2, e2 = max(b, lo), min(e, hi) if hi is not None else e
+    return (b2, e2) if b2 < e2 else None
+
+
+def _sharded_oracle_detect(oracles, cuts, txns, version):
+    """Expected sharded verdicts: N host oracles fed shard-clipped ranges,
+    combined with min (the proxy rule, MasterProxyServer.actor.cpp:492-504).
+    Every oracle sees every transaction (clipped-to-empty ranges removed),
+    matching the device program where clipped ranges become inert."""
+    from foundationdb_tpu.ops.batch import TxnConflictInfo
+
+    n = len(oracles)
+    verdicts = []
+    for d in range(n):
+        lo = cuts[d]
+        hi = cuts[d + 1] if d + 1 < n else None
+        sub = []
+        for t in txns:
+            reads = [r for r in (_clip(p, lo, hi) for p in t.read_ranges) if r]
+            writes = [w for w in (_clip(p, lo, hi) for p in t.write_ranges) if w]
+            # too-old fires on every shard for txns with any read range
+            # anywhere (has_reads is shard-local on device only through
+            # rvalid, which clipping does not change)
+            sub.append(TxnConflictInfo(
+                read_snapshot=t.read_snapshot, read_ranges=reads,
+                write_ranges=writes,
+                ))
+        verdicts.append(oracles[d].detect(sub, version))
+    combined = [min(v[t] for v in verdicts) for t in range(len(txns))]
+    return combined
+
+
+@pytest.mark.parametrize("seed", [1, 2, 7])
+def test_sharded_matches_clipped_oracles(seed):
+    """Exact parity: device sharded verdicts == N shard-clipped host oracles
+    with min-combine. Also: no false commits vs the single-device engine
+    (sharded COMMITTED implies single-device COMMITTED; per-shard write
+    retention can only add conflicts, Resolver.actor.cpp semantics)."""
+    from foundationdb_tpu.parallel.sharded_conflict import shard_cut_bytes
+
+    mesh = make_resolver_mesh(8)
+    n = mesh.devices.size
+    cuts = shard_cut_bytes(n)
+    sharded = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=256, txns=16, reads_per_txn=4, writes_per_txn=4)
+    single = DeviceConflictSet(
+        capacity=256, txns=16, reads_per_txn=4, writes_per_txn=4)
+    oracles = [OracleConflictSet() for _ in range(n)]
+    for txns, version in _random_batches(seed, n_batches=12, txns_per_batch=10):
+        got = sharded.detect(txns, version)
+        want = _sharded_oracle_detect(oracles, cuts, txns, version)
+        assert got == want
+        base = single.detect(txns, version)
+        for g, b in zip(got, base):
+            if g == COMMITTED:
+                assert b == COMMITTED  # no false commits
+
+
+def test_sharded_cross_shard_range():
+    """A single write range spanning every shard must conflict a later read."""
+    mesh = make_resolver_mesh(8)
+    cs = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=64, txns=4, reads_per_txn=2, writes_per_txn=2)
+    whole = (b"\x00", b"\xff\xff")
+    assert cs.detect([TxnConflictInfo(read_snapshot=0, write_ranges=[whole])],
+                     10) == [COMMITTED]
+    # stale read anywhere in the space conflicts
+    for k in [b"\x01", b"\x40zz", b"\x80", b"\xc0\x01", b"\xfe"]:
+        got = cs.detect(
+            [TxnConflictInfo(read_snapshot=5,
+                             read_ranges=[(k, k + b"\x00")],
+                             write_ranges=[])], 20)
+        assert got == [CONFLICT], k
+    # fresh read commits
+    assert cs.detect([TxnConflictInfo(read_snapshot=25,
+                                      read_ranges=[(b"\x40", b"\x41")])],
+                     30) == [COMMITTED]
+
+
+def test_sharded_clear():
+    mesh = make_resolver_mesh(8)
+    cs = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=64, txns=4, reads_per_txn=2, writes_per_txn=2)
+    cs.detect([TxnConflictInfo(read_snapshot=0, write_ranges=[(b"a", b"b")])], 10)
+    cs.clear(oldest_version=100)
+    assert cs.detect(
+        [TxnConflictInfo(read_snapshot=100, read_ranges=[(b"a", b"b")])],
+        110) == [COMMITTED]
