@@ -339,6 +339,22 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     out_vals = jnp.where(jnp.arange(K) < n2, fvals[:K], NEG)
     out_keys = jnp.where((jnp.arange(K) < n2)[None, :], out_keys,
                          jnp.broadcast_to(maxk, (L, K)))
+
+    # Overflow poisons the state (sticky): truncation would drop the
+    # highest-key history segments and cause FALSE COMMITS for batches
+    # already enqueued behind this one (detect_async pipelines without a
+    # host sync). Instead the whole keyspace collapses to one segment at
+    # vnew, so every later stale read conflicts — conservative-only — until
+    # the owner sees info["overflow"] and reconstructs (clearConflictSet
+    # semantics, SkipList.cpp:957). This batch's own statuses are computed
+    # pre-merge and remain exact.
+    poisoned = state["poisoned"] | overflow
+    pois_keys = jnp.broadcast_to(maxk, (L, K)).at[:, 0].set(
+        jnp.asarray(keylib.MIN_LIMBS, dtype=jnp.uint32))
+    pois_vals = jnp.full(K, NEG, jnp.int32).at[0].set(vnew)
+    out_keys = jnp.where(poisoned, pois_keys, out_keys)
+    out_vals = jnp.where(poisoned, pois_vals, out_vals)
+    n2 = jnp.where(poisoned, 1, n2)
     new_table = _build_table(out_vals)
 
     new_state = {
@@ -347,8 +363,9 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
         "nb": jnp.minimum(n2, K).astype(jnp.int32),
         "oldest": new_oldest.astype(jnp.int32),
         "table": new_table,
+        "poisoned": poisoned,
     }
-    info = {"overflow": overflow, "boundaries": n2,
+    info = {"overflow": poisoned, "boundaries": n2,
             "committed": jnp.sum(commit.astype(jnp.int32))}
     return new_state, statuses, info
 
@@ -363,6 +380,7 @@ def rebase_state(state: dict, delta: int):
         "nb": state["nb"],
         "oldest": jnp.maximum(state["oldest"] - d, NEG),
         "table": _build_table(bval),
+        "poisoned": state["poisoned"],
     }
 
 
@@ -377,6 +395,7 @@ def init_state(shapes: ConflictShapes, oldest: int = 0):
         "nb": jnp.int32(1),
         "oldest": jnp.int32(oldest),
         "table": _build_table(jnp.asarray(bval)),
+        "poisoned": jnp.asarray(False),
     }
 
 
@@ -390,35 +409,33 @@ def _compiled_step(shapes: ConflictShapes, max_write_life: int):
     return jax.jit(functools.partial(
         conflict_step, shapes=shapes, max_write_life=max_write_life))
 
-class DeviceConflictSet:
-    """Drop-in conflict set backed by the jitted device step.
 
-    Mirrors the seam in fdbserver/ConflictSet.h:27-44: construct, feed batches
-    of TxnConflictInfo, get {CONFLICT, TOO_OLD, COMMITTED} per transaction.
-    Arbitrary batch sizes are handled by chunking to the static shape
-    (chunk order preserves batch order, so intra-batch "earlier txns win"
-    semantics are exact: later chunks see earlier chunks' merged writes).
-    """
+def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
+                    writes_per_txn=None) -> ConflictShapes:
+    k = KNOBS
+    t = txns or k.CONFLICT_BATCH_TXNS
+    return ConflictShapes(
+        capacity=capacity or k.CONFLICT_STATE_CAPACITY,
+        txns=t,
+        reads=t * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
+        writes=t * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
+    )
 
-    def __init__(self, capacity: int | None = None, txns: int | None = None,
-                 reads_per_txn: int | None = None, writes_per_txn: int | None = None,
-                 oldest_version: int = 0):
-        k = KNOBS
-        self.shapes = ConflictShapes(
-            capacity=capacity or k.CONFLICT_STATE_CAPACITY,
-            txns=txns or k.CONFLICT_BATCH_TXNS,
-            reads=(txns or k.CONFLICT_BATCH_TXNS) * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
-            writes=(txns or k.CONFLICT_BATCH_TXNS) * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
-        )
-        self.base_version = oldest_version
-        self.oldest_version = oldest_version
-        self._state = init_state(self.shapes, oldest=0)
-        self._step = _compiled_step(self.shapes,
-                                    KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
-    # -- encoding --
-    def _encode_batch(self, txns: list[TxnConflictInfo], commit_version: int,
-                      skip: list[bool] | None = None):
+class BatchEncoder:
+    """Host-side batch encoding/chunking, shared by the single-device and
+    mesh-sharded engines (and the driver entry points)."""
+
+    def __init__(self, shapes: ConflictShapes, base_version: int = 0):
+        self.shapes = shapes
+        self.base_version = base_version
+
+    def _clamp_off(self, version: int) -> int:
+        off = version - self.base_version
+        return int(max(min(off, (1 << 31) - 1), int(NEG)))
+
+    def encode_batch(self, txns: list[TxnConflictInfo], commit_version: int,
+                     skip: list[bool] | None = None):
         """Build one device batch. Key encoding is bulk (C extension when
         available — feeding the device is a host hot path, the analogue of
         the reference's C++ key juggling in SkipList.cpp addTransaction)."""
@@ -467,56 +484,7 @@ class DeviceConflictSet:
             "advance_floor": jnp.asarray(True),
         }
 
-    def _clamp_off(self, version: int) -> int:
-        off = version - self.base_version
-        return int(max(min(off, (1 << 31) - 1), int(NEG)))
-
-    def _maybe_rebase(self, commit_version: int):
-        # Shift in <= 2^30 steps so each delta fits int32; values saturate at
-        # NEG, so repeated shifts are exact for any version gap.
-        while commit_version - self.base_version > _REBASE_THRESHOLD:
-            delta = min(commit_version - self.base_version - (1 << 24), 1 << 30)
-            self._state = rebase_state(self._state, delta)
-            self.base_version += delta
-
-    # -- ConflictBatch interface --
-    def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
-        return self.detect_async(txns, commit_version).result()
-
-    def detect_async(self, txns: list[TxnConflictInfo],
-                     commit_version: int) -> "DetectHandle":
-        """Enqueue the whole logical batch on device and return a handle;
-        no host↔device synchronization happens until handle.result().
-
-        This is the proxy's pipelining pattern (MasterProxyServer.actor.cpp
-        :364-366,426-428): batch N+1's transfer/compute overlaps batch N's
-        result readback.
-        """
-        self._maybe_rebase(commit_version)
-        subs = self._split_for_capacity(txns)
-        # The too-old decision is taken here with exact int64 versions (device
-        # offsets saturate across extreme rebases); flagged txns are excluded
-        # from the device batch entirely.
-        pre_batch_oldest = self.oldest_version
-        chunks = []
-        for i, sub in enumerate(subs):
-            host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
-                            for t in sub]
-            batch = self._encode_batch(sub, commit_version, skip=host_too_old)
-            # the MVCC floor advances once per logical batch (last chunk), so
-            # every chunk's too-old check uses the pre-batch floor
-            batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
-            new_state, statuses, info = self._step(self._state, batch)
-            self._state = new_state
-            chunks.append((len(sub), host_too_old, statuses, info))
-        # the kernel's floor advance is replicated host-side exactly
-        # (floor = commit_version - window on the last chunk, monotonic max)
-        self.oldest_version = max(
-            self.oldest_version,
-            commit_version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
-        return DetectHandle(chunks)
-
-    def _split_for_capacity(self, txns):
+    def split_for_capacity(self, txns):
         sh = self.shapes
         subs, cur, nr, nw = [], [], 0, 0
         for txn in txns:
@@ -533,9 +501,87 @@ class DeviceConflictSet:
         subs.append(cur)
         return subs
 
+
+def detect_async_impl(engine, txns: list[TxnConflictInfo],
+                      commit_version: int) -> "DetectHandle":
+    """Enqueue a whole logical batch on device and return a handle; no
+    host↔device synchronization happens until handle.result().
+
+    Shared by DeviceConflictSet and ShardedDeviceConflictSet (`engine` needs:
+    encoder, _step, _state, oldest_version, _maybe_rebase). This is the
+    proxy's pipelining pattern (MasterProxyServer.actor.cpp:364-366,426-428):
+    batch N+1's transfer/compute overlaps batch N's result readback.
+    """
+    engine._maybe_rebase(commit_version)
+    enc = engine.encoder
+    subs = enc.split_for_capacity(txns)
+    # The too-old decision is taken here with exact int64 versions (device
+    # offsets saturate across extreme rebases); flagged txns are excluded
+    # from the device batch entirely.
+    pre_batch_oldest = engine.oldest_version
+    chunks = []
+    for i, sub in enumerate(subs):
+        host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
+                        for t in sub]
+        batch = enc.encode_batch(sub, commit_version, skip=host_too_old)
+        # the MVCC floor advances once per logical batch (last chunk), so
+        # every chunk's too-old check uses the pre-batch floor
+        batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
+        new_state, statuses, info = engine._step(engine._state, batch)
+        engine._state = new_state
+        chunks.append((len(sub), host_too_old, statuses, info))
+    # the kernel's floor advance is replicated host-side exactly
+    # (floor = commit_version - window on the last chunk, monotonic max)
+    engine.oldest_version = max(
+        engine.oldest_version,
+        commit_version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+    return DetectHandle(chunks)
+
+
+class DeviceConflictSet:
+    """Drop-in conflict set backed by the jitted device step.
+
+    Mirrors the seam in fdbserver/ConflictSet.h:27-44: construct, feed batches
+    of TxnConflictInfo, get {CONFLICT, TOO_OLD, COMMITTED} per transaction.
+    Arbitrary batch sizes are handled by chunking to the static shape
+    (chunk order preserves batch order, so intra-batch "earlier txns win"
+    semantics are exact: later chunks see earlier chunks' merged writes).
+    """
+
+    def __init__(self, capacity: int | None = None, txns: int | None = None,
+                 reads_per_txn: int | None = None, writes_per_txn: int | None = None,
+                 oldest_version: int = 0):
+        self.shapes = _resolve_shapes(capacity, txns, reads_per_txn, writes_per_txn)
+        self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
+        self.oldest_version = oldest_version
+        self._state = init_state(self.shapes, oldest=0)
+        self._step = _compiled_step(self.shapes,
+                                    KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+
+    @property
+    def base_version(self) -> int:
+        return self.encoder.base_version
+
+    def _maybe_rebase(self, commit_version: int):
+        # Shift in <= 2^30 steps so each delta fits int32; values saturate at
+        # NEG, so repeated shifts are exact for any version gap.
+        while commit_version - self.encoder.base_version > _REBASE_THRESHOLD:
+            delta = min(commit_version - self.encoder.base_version - (1 << 24),
+                        1 << 30)
+            self._state = rebase_state(self._state, delta)
+            self.encoder.base_version += delta
+
+    # -- ConflictBatch interface --
+    def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
+        return self.detect_async(txns, commit_version).result()
+
+    def detect_async(self, txns: list[TxnConflictInfo],
+                     commit_version: int) -> "DetectHandle":
+        return detect_async_impl(self, txns, commit_version)
+
     def clear(self, oldest_version: int = 0):
         """clearConflictSet (SkipList.cpp:957): state is soft/reconstructable."""
-        self.base_version = oldest_version
+        self.encoder.base_version = oldest_version
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)
 

@@ -128,7 +128,7 @@ def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,
     state_specs = {
         "bkeys": P(RESOLVER_AXIS), "bval": P(RESOLVER_AXIS),
         "nb": P(RESOLVER_AXIS), "oldest": P(RESOLVER_AXIS),
-        "table": P(RESOLVER_AXIS),
+        "table": P(RESOLVER_AXIS), "poisoned": P(RESOLVER_AXIS),
     }
     batch_specs = {
         "rb": P(), "re": P(), "rtxn": P(), "wb": P(), "we": P(), "wtxn": P(),
@@ -166,58 +166,37 @@ class ShardedDeviceConflictSet:
     def __init__(self, mesh: Mesh | None = None, capacity: int | None = None,
                  txns: int | None = None, reads_per_txn: int | None = None,
                  writes_per_txn: int | None = None, oldest_version: int = 0):
-        from foundationdb_tpu.ops.conflict import DeviceConflictSet
-        k = KNOBS
+        from foundationdb_tpu.ops.conflict import BatchEncoder, _resolve_shapes
+
         self.mesh = mesh or make_resolver_mesh()
         self.n_shards = self.mesh.devices.size
-        self.shapes = ConflictShapes(
-            capacity=capacity or k.CONFLICT_STATE_CAPACITY,
-            txns=txns or k.CONFLICT_BATCH_TXNS,
-            reads=(txns or k.CONFLICT_BATCH_TXNS) * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
-            writes=(txns or k.CONFLICT_BATCH_TXNS) * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
-        )
-        self.base_version = oldest_version
+        self.shapes = _resolve_shapes(capacity, txns, reads_per_txn, writes_per_txn)
+        self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
         self.oldest_version = oldest_version
         self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0)
         self._step = sharded_conflict_step(
             self.mesh, self.shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
-        # reuse DeviceConflictSet's host-side encoding/chunking machinery
-        self._enc = DeviceConflictSet.__new__(DeviceConflictSet)
-        self._enc.shapes = self.shapes
-        self._enc.base_version = self.base_version
+
+    @property
+    def base_version(self) -> int:
+        return self.encoder.base_version
 
     def _maybe_rebase(self, commit_version: int):
-        while commit_version - self.base_version > _REBASE_THRESHOLD:
-            delta = min(commit_version - self.base_version - (1 << 24), 1 << 30)
+        while commit_version - self.encoder.base_version > _REBASE_THRESHOLD:
+            delta = min(commit_version - self.encoder.base_version - (1 << 24),
+                        1 << 30)
             self._state = jax.vmap(lambda s: rebase_state(s, delta))(self._state)
-            self.base_version += delta
-            self._enc.base_version = self.base_version
+            self.encoder.base_version += delta
 
     def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
         return self.detect_async(txns, commit_version).result()
 
     def detect_async(self, txns: list[TxnConflictInfo], commit_version: int):
-        from foundationdb_tpu.ops.conflict import DetectHandle
+        from foundationdb_tpu.ops.conflict import detect_async_impl
 
-        self._maybe_rebase(commit_version)
-        subs = self._enc._split_for_capacity(txns)
-        pre_batch_oldest = self.oldest_version
-        chunks = []
-        for i, sub in enumerate(subs):
-            host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
-                            for t in sub]
-            batch = self._enc._encode_batch(sub, commit_version, skip=host_too_old)
-            batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
-            new_state, statuses, info = self._step(self._state, batch)
-            self._state = new_state
-            chunks.append((len(sub), host_too_old, statuses, info))
-        self.oldest_version = max(
-            self.oldest_version,
-            commit_version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
-        return DetectHandle(chunks)
+        return detect_async_impl(self, txns, commit_version)
 
     def clear(self, oldest_version: int = 0):
-        self.base_version = oldest_version
+        self.encoder.base_version = oldest_version
         self.oldest_version = oldest_version
-        self._enc.base_version = oldest_version
         self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0)
