@@ -33,7 +33,14 @@ class Token:
     STORAGE_GET_KEY_VALUES = 41
     STORAGE_WATCH_VALUE = 42
     STORAGE_GET_SHARD_STATE = 43
+    TLOG_LOCK = 33
+    STORAGE_SET_LOGSYSTEM = 44
     WORKER_PING = 90
+    WORKER_INIT_ROLE = 91
+    CC_REGISTER_WORKER = 95
+    CC_GET_DBINFO = 96
+    CC_SET_DBINFO = 97
+    CC_GET_WORKERS = 98
 
 
 # --- master ---
@@ -131,6 +138,10 @@ class TLogPeekReply:
     messages: list[tuple[int, list[Mutation]]]  # [(version, mutations)]
     end: int  # exclusive: peeker has everything < end for this tag
     popped: int
+    # highest fully-acknowledged commit the pushers reported; storage caps
+    # engine durability here so an unacked mutation can never outlive a
+    # recovery rollback (storageserver updateStorage / kcv semantics)
+    known_committed_version: int = 0
 
 
 @dataclass
@@ -212,3 +223,76 @@ class WatchValueRequest:
     key: bytes
     value: bytes | None  # value the client last saw
     version: int
+
+
+# --- recovery / recruitment (WorkerInterface.h Initialize*Request family) ---
+
+@dataclass
+class TLogLockRequest:
+    """Epoch end (ILogSystem::epochEnd): stop accepting commits; report how
+    far this log got. masterserver recoverFrom locks the old generation."""
+
+    epoch: int
+
+
+@dataclass
+class TLogLockReply:
+    known_committed_version: int
+    durable_version: int
+
+
+@dataclass
+class LogEpoch:
+    """One generation of the log system (LogSystemConfig.h oldTLogs entry):
+    versions in [begin, end) are served by these TLogs (end None = current)."""
+
+    begin: int
+    end: int | None
+    addrs: list[str]
+
+
+@dataclass
+class SetLogSystemRequest:
+    """Master -> storage after recovery: new epoch list + rollback point
+    (storageserver rollback :2211 discards versions the new log system does
+    not know)."""
+
+    epochs: list  # list[LogEpoch]
+    rollback_to: int
+    recovery_count: int
+
+
+@dataclass
+class InitRoleRequest:
+    """worker.actor.cpp:694-794 InitializeTLog/Storage/Proxy/ResolverRequest,
+    collapsed into one parameterized request."""
+
+    role: str  # "tlog" | "storage" | "proxy" | "resolver" | "master"
+    args: dict
+
+
+@dataclass
+class InitRoleReply:
+    address: str
+
+
+@dataclass
+class RegisterWorkerRequest:
+    address: str
+    roles: list[str]
+
+
+@dataclass
+class DBInfo:
+    """ServerDBInfo: everything a worker/client needs to find the cluster.
+    Broadcast by the CC (ClusterController.actor.cpp ServerDBInfo)."""
+
+    version: int
+    epoch: int
+    master: str | None
+    proxies: list[str]
+    resolvers: list[str]
+    log_epochs: list  # list[LogEpoch]
+    storages: list[tuple[str, int]]  # (address, tag)
+    shard_boundaries: list[bytes]
+    recovery_state: str = "unrecovered"

@@ -20,7 +20,8 @@ from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
     GetKeyValuesReply, GetKeyValuesRequest, GetValueReply, GetValueRequest,
-    KeySelector, TLogPeekRequest, TLogPopRequest, Token, WatchValueRequest)
+    KeySelector, LogEpoch, SetLogSystemRequest, TLogPeekRequest,
+    TLogPopRequest, Token, WatchValueRequest)
 from foundationdb_tpu.server.versioned_map import VersionedMap
 from foundationdb_tpu.storage.kvstore import MemoryKeyValueStore
 from foundationdb_tpu.utils.errors import FDBError
@@ -31,10 +32,13 @@ _DURABLE_VERSION_KEY = "durableVersion"
 
 
 class StorageServer:
-    def __init__(self, process: SimProcess, tag: int, tlog_addrs: list[str],
-                 recovery_version: int = 0):
-        """Peeks go to the first TLog; pops go to every TLog holding the tag
-        (each replica stores the tag, so each must be told to reclaim).
+    def __init__(self, process: SimProcess, tag: int,
+                 tlog_addrs: list[str] | None = None,
+                 recovery_version: int = 0,
+                 log_epochs: list[LogEpoch] | None = None):
+        """Pulls its tag from the log system's epoch list (version-routed:
+        epoch (begin, end] served by that generation's TLogs); pops go to
+        every TLog of every epoch holding the tag.
 
         Durability (updateStorage :2633 + restoreDurableState :2871): every
         mutation leaving the MVCC window is applied to a durable KV engine
@@ -44,8 +48,11 @@ class StorageServer:
         """
         self.process = process
         self.tag = tag
-        self._peek_ep = Endpoint(tlog_addrs[0], Token.TLOG_PEEK)
-        self._pop_eps = [Endpoint(a, Token.TLOG_POP) for a in tlog_addrs]
+        if log_epochs is None:
+            log_epochs = [LogEpoch(begin=0, end=None, addrs=list(tlog_addrs or []))]
+        self.log_epochs: list[LogEpoch] = log_epochs
+        self.recovery_count = 0
+        self._peek_rotation = 0  # failover index within an epoch's addrs
         self.store = MemoryKeyValueStore(
             process.net.open_file(process, f"storage-{tag}.0"),
             process.net.open_file(process, f"storage-{tag}.1"))
@@ -64,7 +71,31 @@ class StorageServer:
         process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
         process.register(Token.STORAGE_GET_KEY_VALUES, self._on_get_key_values)
         process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
+        process.register(Token.STORAGE_SET_LOGSYSTEM, self._on_set_logsystem)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
+
+    # -- recovery (rollback :2211 + log-system rebind) --
+
+    def _on_set_logsystem(self, req: SetLogSystemRequest, reply):
+        if req.recovery_count <= self.recovery_count:
+            reply.send(None)  # stale recovery broadcast
+            return
+        self.recovery_count = req.recovery_count
+        # discard in-memory versions the new log system does not know; they
+        # were never reported committed (the recovery version is min-durable
+        # over a locked quorum, so every acked commit is <= rollback_to)
+        self.data.rollback(max(req.rollback_to, self.durable_version))
+        while self._pending_durable and self._pending_durable[-1][0] > req.rollback_to:
+            self._pending_durable.pop()
+        self.log_epochs = req.epochs
+        reply.send(None)
+
+    def _epoch_for(self, version: int) -> LogEpoch:
+        """The generation serving `version`: epoch covers (begin, end]."""
+        for ep in self.log_epochs:
+            if version > ep.begin and (ep.end is None or version <= ep.end):
+                return ep
+        return self.log_epochs[-1]
 
     # -- ingestion (update :2358 + updateStorage :2633) --
 

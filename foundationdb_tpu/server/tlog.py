@@ -21,9 +21,10 @@ from collections import deque
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
 from foundationdb_tpu.server.interfaces import (
-    TLogCommitReply, TLogCommitRequest, TLogPeekReply, TLogPeekRequest,
-    TLogPopRequest, Token)
+    TLogCommitReply, TLogCommitRequest, TLogLockReply, TLogLockRequest,
+    TLogPeekReply, TLogPeekRequest, TLogPopRequest, Token)
 from foundationdb_tpu.storage.diskqueue import DiskQueue
+from foundationdb_tpu.utils.errors import FDBError
 
 
 class TLog:
@@ -34,18 +35,40 @@ class TLog:
         self.messages: dict[int, deque] = {}  # tag -> deque[(version, [Mutation])]
         self.popped: dict[int, int] = {}  # tag -> pop floor
         self.known_committed_version = recovery_version
+        self.locked = False  # epoch ended: no more commits (recovery lock)
         self.queue = DiskQueue(process.net.open_file(process, file_name + ".0"),
                                process.net.open_file(process, file_name + ".1"))
         self._version_seq: deque[tuple[int, int]] = deque()  # (version, seq)
         process.register(Token.TLOG_COMMIT, self._on_commit)
         process.register(Token.TLOG_PEEK, self._on_peek)
         process.register(Token.TLOG_POP, self._on_pop)
+        process.register(Token.TLOG_LOCK, self._on_lock)
+
+    def _on_lock(self, req: TLogLockRequest, reply):
+        """Epoch end: fence old-generation commits (TLogServer lock path /
+        epochEnd). Idempotent; reports how far this log durably got so the
+        master can pick the recovery version."""
+        if not self.locked:
+            self.locked = True
+            # persist the fence: a rebooted locked TLog must stay locked or a
+            # zombie old-generation proxy could commit past the recovery point
+            self.queue.push(pickle.dumps({"lock": req.epoch}))
+            self.queue.commit()
+        reply.send(TLogLockReply(
+            known_committed_version=self.known_committed_version,
+            durable_version=self.version.get()))
 
     def _on_commit(self, req: TLogCommitRequest, reply):
         self.process.spawn(self._commit(req, reply), "tLogCommit")
 
     async def _commit(self, req: TLogCommitRequest, reply):
+        if self.locked:
+            reply.send_error(FDBError("tlog_stopped"))
+            return
         await self.version.when_at_least(req.prev_version)
+        if self.locked:
+            reply.send_error(FDBError("tlog_stopped"))
+            return
         if req.version <= self.version.get():
             reply.send(TLogCommitReply(version=self.version.get()))  # duplicate
             return
@@ -70,8 +93,10 @@ class TLog:
         await self.version.when_at_least(req.begin)
         out = [(v, list(muts)) for v, muts in self.messages.get(req.tag, ())
                if v >= req.begin]
-        reply.send(TLogPeekReply(messages=out, end=self.version.get() + 1,
-                                 popped=self.popped.get(req.tag, 0)))
+        reply.send(TLogPeekReply(
+            messages=out, end=self.version.get() + 1,
+            popped=self.popped.get(req.tag, 0),
+            known_committed_version=self.known_committed_version))
 
     def _on_pop(self, req: TLogPopRequest, reply):
         self.popped[req.tag] = max(self.popped.get(req.tag, 0), req.version)
@@ -99,7 +124,11 @@ class TLog:
         """Rebuild in-memory deques from the durable queue after a reboot."""
         last = self.version.get()
         for seq, payload in self.queue.recover():
-            version, messages = pickle.loads(payload)
+            obj = pickle.loads(payload)
+            if isinstance(obj, dict) and "lock" in obj:
+                self.locked = True
+                continue
+            version, messages = obj
             self._version_seq.append((version, seq))
             for tag, muts in messages.items():
                 if muts:

@@ -149,6 +149,26 @@ class VersionedMap:
             i = bisect.bisect_left(self._index, key)
             del self._index[i]
 
+    def rollback(self, version: int):
+        """Discard versions > `version` (storageserver.actor.cpp:2211): a
+        master recovery chose `version` as the epoch end, so anything newer
+        in memory was never committed and must vanish before the new epoch's
+        mutations (which reuse higher version numbers) arrive."""
+        if version >= self.latest_version:
+            return
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = bisect.bisect_right(chain, version, key=lambda e: e[0])
+            if i < len(chain):
+                del chain[i:]
+            if not chain:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._index, key)
+            del self._index[i]
+        self.latest_version = version
+
     # -- introspection --
 
     def key_count(self) -> int:
