@@ -45,9 +45,16 @@ class TaskPriority:
 
 
 class ActorTask(Future):
-    """A running coroutine; also the Future of its final result."""
+    """A running coroutine; also the Future of its final result.
 
-    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled")
+    Unhandled-error contract (Flow's SAV error delivery, flow/flow.h): an
+    actor that dies with an error *nobody is waiting on* must not fail
+    silently — the loop reports it loudly (default: raise out of the run
+    loop). operation_cancelled is benign (that's how kills reap actors).
+    """
+
+    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled",
+                 "_observed")
 
     def __init__(self, loop: "EventLoop", coro: Coroutine, name: str):
         super().__init__()
@@ -56,6 +63,26 @@ class ActorTask(Future):
         self.name = name
         self._waiting_on: Future | None = None
         self._cancelled = False
+        self._observed = False
+
+    def add_callback(self, cb):
+        self._observed = True
+        super().add_callback(cb)
+
+    def add_system_callback(self, cb):
+        """Bookkeeping callback that does NOT count as observing the result
+        (used by SimProcess's actor registry)."""
+        super().add_callback(cb)
+
+    # awaiting/getting an already-failed task raises inline without going
+    # through add_callback — still counts as observing the error
+    def __await__(self):
+        self._observed = True
+        return super().__await__()
+
+    def get(self):
+        self._observed = True
+        return super().get()
 
     def cancel(self):
         """Inject operation_cancelled at the actor's current wait point."""
@@ -89,7 +116,17 @@ class ActorTask(Future):
             self._set(stop.value)
             return
         except BaseException as e:  # noqa: BLE001
-            self._set_error(e)
+            err = e  # `e` is unbound once the except block exits (PEP 3110)
+            self._set_error(err)
+            if not self._observed and not (
+                    isinstance(err, FDBError) and err.name == "operation_cancelled"):
+                # defer one scheduler turn at the lowest priority: a caller
+                # that awaits the task in the same virtual instant observes it
+                # first; only a genuinely unwatched death reports
+                self._loop._schedule(
+                    0.0, TaskPriority.Zero,
+                    lambda: None if self._observed
+                    else self._loop._report_unhandled(self, err))
             return
         self._waiting_on = waited
         waited.add_callback(self._on_waited)
@@ -107,6 +144,18 @@ class EventLoop:
         self._seq = 0
         self._heap: list[tuple[float, int, int, Any]] = []
         self._stopped = False
+        # Override to tolerate unobserved actor errors (takes (task, error));
+        # None = trace at SevError and raise, crashing the run loop.
+        self.on_unhandled_actor_error = None
+
+    def _report_unhandled(self, task: "ActorTask", error: BaseException):
+        if self.on_unhandled_actor_error is not None:
+            self.on_unhandled_actor_error(task, error)
+            return
+        from foundationdb_tpu.utils.trace import TraceEvent
+        TraceEvent("UnhandledActorError", task.name).detail(
+            "Error", repr(error)).log()
+        raise error
 
     # -- clock --
     def now(self) -> float:
@@ -149,6 +198,8 @@ class EventLoop:
 
     def run_future(self, fut: Future, max_time: float | None = None) -> Any:
         """Run until `fut` resolves; returns its value (or raises)."""
+        if isinstance(fut, ActorTask):
+            fut._observed = True  # the caller is watching this actor
         self._stopped = False
         while not fut.is_ready() and self._heap and not self._stopped:
             t, negp, seq, fn = heapq.heappop(self._heap)

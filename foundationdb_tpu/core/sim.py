@@ -63,8 +63,8 @@ class SimProcess:
         self.actors.append(task)
         # completed actors drop out of the kill list (long-lived processes
         # spawn one actor per request; keeping them all would leak)
-        task.add_callback(lambda _f: self.actors.remove(task)
-                          if task in self.actors else None)
+        task.add_system_callback(lambda _f: self.actors.remove(task)
+                                 if task in self.actors else None)
         return task
 
     # -- endpoint registration (RequestStream server side) --
@@ -103,6 +103,11 @@ class SimFile:
         """Discard all contents (durable and pending) — used by DiskQueue
         file alternation; the truncate itself is treated as durable."""
         self.durable = b""
+        self.pending.clear()
+
+    def truncate_to(self, size: int):
+        """Durably truncate to `size` bytes (ftruncate semantics)."""
+        self.durable = self.read_all()[:size]
         self.pending.clear()
 
     def on_kill(self):

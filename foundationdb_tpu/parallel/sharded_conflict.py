@@ -121,7 +121,8 @@ def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,
         info = {
             "overflow": lax.pmax(info["overflow"], RESOLVER_AXIS),
             "boundaries": lax.pmax(info["boundaries"], RESOLVER_AXIS),
-            "committed": jnp.sum(statuses == 2),
+            # mask padding slots (forced COMMITTED inside conflict_step)
+            "committed": jnp.sum((statuses == 2) & batch["txn_valid"]),
         }
         return jax.tree.map(lambda x: x[None], new_state), statuses, info
 

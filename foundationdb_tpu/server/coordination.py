@@ -22,6 +22,7 @@ recoveries (the reference's usage: the cstate holds the log-system config).
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass
 from typing import Any
 
@@ -195,7 +196,10 @@ class CoordinatedStateClient:
         # unique per (process, attempt): high bits attempt counter, low bits
         # a stable per-process tag derived from the address hash
         self._ballot = max(self._ballot + 1, floor + 1)
-        tag = abs(hash(self.process.address)) % 1000
+        # stable across interpreters (str hash is PYTHONHASHSEED-salted, which
+        # would break deterministic simulation) and well-spread over the tag
+        # space to avoid ballot collisions between processes
+        tag = zlib.crc32(self.process.address.encode()) % 1000
         return self._ballot * 1000 + tag
 
     async def _quorum_call(self, token: int, make_req) -> list:

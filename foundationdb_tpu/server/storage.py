@@ -66,6 +66,10 @@ class StorageServer:
                             Mutation(MutationType.SET_VALUE, k, v))
         self.data.oldest_version = self.durable_version
         self.version = NotifiedVersion(self.durable_version)  # latest applied
+        # Pull cursor: unlike self.version (monotone; readers wait on it) this
+        # can move backwards on rollback, so re-delivered mutations from a new
+        # epoch in (rollback_to, old_version] are re-fetched, not skipped.
+        self._peek_begin = self.durable_version
         self._pending_durable: deque[tuple[int, list]] = deque()
         self._watches: list[tuple[WatchValueRequest, object]] = []
         process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
@@ -84,9 +88,16 @@ class StorageServer:
         # discard in-memory versions the new log system does not know; they
         # were never reported committed (the recovery version is min-durable
         # over a locked quorum, so every acked commit is <= rollback_to)
-        self.data.rollback(max(req.rollback_to, self.durable_version))
-        while self._pending_durable and self._pending_durable[-1][0] > req.rollback_to:
+        rollback_to = max(req.rollback_to, self.durable_version)
+        self.data.rollback(rollback_to)
+        while self._pending_durable and self._pending_durable[-1][0] > rollback_to:
             self._pending_durable.pop()
+        # rewind the pull cursor so the new epoch's re-delivered mutations in
+        # (rollback_to, old_version] are fetched; self.version stays monotone
+        # (the master allocates the new epoch's first version above any version
+        # a storage server can have seen, masterserver.actor.cpp:858 bump)
+        self._peek_begin = rollback_to
+        self._peek_rotation = 0
         self.log_epochs = req.epochs
         reply.send(None)
 
@@ -100,29 +111,53 @@ class StorageServer:
     # -- ingestion (update :2358 + updateStorage :2633) --
 
     async def _update_loop(self):
+        loop = self.process.net.loop
         while True:
+            epoch = self._epoch_for(self._peek_begin + 1)
+            addr = epoch.addrs[self._peek_rotation % len(epoch.addrs)]
+            recovery_count = self.recovery_count
             try:
-                reply = await self.process.net.request(
-                    self.process, self._peek_ep,
-                    TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1))
-            except FDBError:
-                # TLog dead/rebooting: back off and re-peek (the reference's
-                # peek cursor reconnects through the log system config)
-                await self.process.net.loop.delay(0.5)
+                # bounded wait: a silently-dropped packet (clog/partition)
+                # must also trigger replica failover, not hang ingestion
+                reply = await loop.timeout(self.process.net.request(
+                    self.process, Endpoint(addr, Token.TLOG_PEEK),
+                    TLogPeekRequest(tag=self.tag, begin=self._peek_begin + 1)),
+                    2.0)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise  # killed: this loop must die, not zombie past reboot
+                # TLog dead/rebooting/unreachable: fail over to the epoch's
+                # next replica (the reference's peek cursor reconnects via
+                # the log system config)
+                self._peek_rotation += 1
+                await loop.delay(0.5)
+                continue
+            if self.recovery_count != recovery_count:
+                # a rollback/rebind landed while this peek was in flight; the
+                # reply may carry the dead epoch's never-acked versions
                 continue
             for version, muts in reply.messages:
-                if version <= self.version.get():
+                if version <= self._peek_begin:
                     continue
+                if epoch.end is not None and version > epoch.end:
+                    break  # next iteration peeks the successor epoch
                 for m in muts:
                     self.data.apply(version, m)
                 self._pending_durable.append((version, muts))
-                self.version.set(version)
+                self._peek_begin = version
+                if version > self.version.get():
+                    self.version.set(version)
                 self._trigger_watches(version)
-            if reply.end - 1 > self.version.get():
-                # a gap can't happen with one tlog; guard for multi-log later
-                self.version.set(reply.end - 1)
-                self.data.latest_version = max(self.data.latest_version, reply.end - 1)
-                self._trigger_watches(reply.end - 1)
+            # advance through empty version ranges, clamped to this epoch
+            end_v = reply.end - 1
+            if epoch.end is not None:
+                end_v = min(end_v, epoch.end)
+            if end_v > self._peek_begin:
+                self._peek_begin = end_v
+                if end_v > self.version.get():
+                    self.version.set(end_v)
+                    self.data.latest_version = max(self.data.latest_version, end_v)
+                    self._trigger_watches(end_v)
             self._advance_durability()
 
     def _advance_durability(self):
@@ -130,7 +165,10 @@ class StorageServer:
         the durable engine, commit, then forget them from memory and pop the
         TLog — pop strictly after the engine commit, so a crash between the
         two only re-applies (idempotent) mutations."""
-        target = self.version.get() - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS
+        # derive from the pull cursor, not self.version: after a rollback the
+        # monotone version can exceed what has been re-fetched, and durability
+        # (and TLog pops!) must never pass unfetched mutations
+        target = self._peek_begin - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS
         if target <= self.durable_version:
             return
         while self._pending_durable and self._pending_durable[0][0] <= target:
@@ -141,10 +179,22 @@ class StorageServer:
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
         self.store.commit()
         self.data.forget_before(target)
-        for ep in self._pop_eps:
-            self.process.net.one_way(
-                self.process, ep,
-                TLogPopRequest(tag=self.tag, version=target))
+        popped: set[str] = set()
+        for epoch in self.log_epochs:
+            for addr in epoch.addrs:
+                if addr in popped:
+                    continue
+                popped.add(addr)
+                self.process.net.one_way(
+                    self.process, Endpoint(addr, Token.TLOG_POP),
+                    TLogPopRequest(tag=self.tag, version=target))
+        # prune fully-drained generations (the reference discards a log
+        # generation once every tag is popped past its end) — bounds the pop
+        # fan-out as recoveries accumulate; pruned after this round's pop so
+        # each drained generation gets its final pop
+        if len(self.log_epochs) > 1:
+            self.log_epochs = [ep for ep in self.log_epochs
+                               if ep.end is None or ep.end > target]
 
     def _apply_durable(self, m):
         from foundationdb_tpu.utils.types import ATOMIC_OPS, apply_atomic_op

@@ -10,8 +10,9 @@ Entries get monotonically increasing sequence numbers. The owner maps its own
 notion of position (e.g. TLog versions) to sequences.
 
 File interface required: append(bytes), sync(), read_all() -> bytes,
-truncate() — satisfied by core.sim.SimFile (which loses unsynced appends on a
-simulated kill) and storage.localfile.LocalFile (real fsync'd files).
+truncate(), truncate_to(size) — satisfied by core.sim.SimFile (which loses
+unsynced appends on a simulated kill) and storage.localfile.LocalFile (real
+fsync'd files; truncate_to = ftruncate).
 """
 
 from __future__ import annotations
@@ -33,7 +34,7 @@ def _page_crc(seq: int, pop_seq: int, payload: bytes) -> int:
 
 
 def _parse_entries(raw: bytes):
-    """Yield (seq, pop_seq, payload) until the first torn/corrupt page."""
+    """Yield (seq, pop_seq, payload, end_offset) until the first torn page."""
     off = 0
     n = len(raw)
     while off + _HEADER.size <= n:
@@ -43,8 +44,8 @@ def _parse_entries(raw: bytes):
         payload = raw[off + _HEADER.size: off + _HEADER.size + plen]
         if _page_crc(seq, pop_seq, payload) != crc:
             return
-        yield seq, pop_seq, payload
         off += _HEADER.size + plen
+        yield seq, pop_seq, payload, off
 
 
 class DiskQueue:
@@ -112,19 +113,37 @@ class DiskQueue:
         entries = per_file[older] + per_file[newer]
         # pop floor self-described by the pages: popped entries are dead even
         # if still physically present in a not-yet-truncated file
-        floor = max((p for _s, p, _d in entries), default=0)
+        floor = max((p for _s, p, _d, _o in entries), default=0)
         # enforce contiguity from the floor: stop at the first gap (a lost
         # middle page means everything after it is unusable)
         out: list[tuple[int, bytes]] = []
-        for seq, _pop, payload in entries:
+        live: set[int] = set()
+        for seq, _pop, payload, _off in entries:
             if seq < floor:
                 continue
             if out and seq != out[-1][0] + 1:
                 break
             out.append((seq, payload))
-        live = {s for s, _p in out}
-        for f in (older, newer):
-            self._entries[f] = [(s, d) for s, _pop, d in per_file[f] if s in live]
+            live.add(seq)
+        # Truncate each file's DEAD TAIL (pages past the last survivor):
+        # reused sequence numbers appended after them would otherwise alias
+        # stale dead pages on the next recovery. Per-file page runs are
+        # seq-contiguous (files are wiped at swap), so survivors are always a
+        # prefix-after-floor and dead pages past them are a physical tail.
+        # Removing only dead bytes keeps recovery crash-idempotent on real
+        # files (no window where committed data exists only in memory).
+        for f_idx in (older, newer):
+            keep_to = 0
+            for seq, _pop, _d, end_off in per_file[f_idx]:
+                if seq in live or seq < floor:
+                    keep_to = end_off
+                else:
+                    break
+            parsed_to = per_file[f_idx][-1][3] if per_file[f_idx] else 0
+            if keep_to < parsed_to or keep_to < len(self.files[f_idx].read_all()):
+                self.files[f_idx].truncate_to(keep_to)
+            self._entries[f_idx] = [(s, d) for s, _p, d, _o in per_file[f_idx]
+                                    if s in live]
         self.active = newer
         self.next_seq = out[-1][0] + 1 if out else 0
         self.pop_seq = floor

@@ -271,3 +271,41 @@ def test_determinism_same_seed_same_trace():
 
     assert run(5) == run(5)
     assert run(5) != run(6)  # latency schedule differs by seed
+
+
+def test_unobserved_actor_error_is_loud():
+    """Flow contract: an actor error nobody awaits must crash the run loop
+    (flow/flow.h SAV error delivery traces SevError), so a background role
+    actor can never die silently."""
+    loop = EventLoop()
+
+    async def bad():
+        raise FDBError("io_error")
+
+    loop.spawn(bad(), "background")
+    with pytest.raises(FDBError, match="io_error"):
+        loop.run_until_idle(max_time=1.0)
+
+
+def test_observed_actor_error_is_quiet():
+    loop = EventLoop()
+
+    async def bad():
+        raise FDBError("io_error")
+
+    task = loop.spawn(bad(), "background")
+    with pytest.raises(FDBError, match="io_error"):
+        loop.run_future(task)  # the caller observes it; no double report
+
+
+def test_cancelled_actor_is_not_reported():
+    loop, = (EventLoop(),)
+
+    async def forever():
+        await loop.delay(100.0)
+
+    task = loop.spawn(forever(), "victim")
+    loop.run_until_idle(max_time=0.1)
+    task.cancel()
+    loop.run_until_idle(max_time=1.0)  # must not raise
+    assert task.is_error()
