@@ -94,12 +94,18 @@ def _key_eq(a, b):
     return eq
 
 
-def _searchsorted(bkeys, queries, side: str):
+def _searchsorted(bkeys, queries, side):
     """Vectorized binary search over sorted multi-limb keys.
 
     bkeys: (L, K) sorted ascending; queries: (L, Q).
     side='left'  -> first index i with bkeys[:,i] >= q (lower bound)
     side='right' -> first index i with bkeys[:,i] >  q (upper bound)
+    side may also be a (Q,) bool array: True = 'right' for that query,
+    letting several logical searches share one unrolled bisection.
+
+    The bisection is UNROLLED (static step count): a lax loop here costs a
+    device-visible sync per iteration, which profiling showed dominating the
+    whole conflict step.
     """
     K = bkeys.shape[1]
     Q = queries.shape[1]
@@ -107,19 +113,24 @@ def _searchsorted(bkeys, queries, side: str):
     hi = jnp.full(Q, K, dtype=jnp.int32)
     steps = max(1, int(np.ceil(np.log2(max(K, 2)))) + 1)
 
-    def body(_i, lohi):
-        lo, hi = lohi
+    for _ in range(steps):
         mid = (lo + hi) // 2
         midkeys = bkeys[:, mid]  # (L, Q) gather
-        if side == "left":
-            go_right = _key_lt(midkeys, queries)
+        if isinstance(side, str):
+            if side == "left":
+                go_right = _key_lt(midkeys, queries)
+            else:
+                go_right = ~_key_lt(queries, midkeys)  # midkeys <= q
         else:
-            go_right = ~_key_lt(queries, midkeys)  # midkeys <= q
+            go_right = jnp.where(side, ~_key_lt(queries, midkeys),
+                                 _key_lt(midkeys, queries))
+        # once converged (lo == hi) the interval is empty: without this guard
+        # a surplus unrolled step at lo == hi == K gathers the clamped last
+        # key and can push lo to K+1 for queries above every stored key,
+        # which the merge's slot arithmetic would consume unclamped
+        go_right = go_right & (lo < hi)
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right, hi, mid)
-        return lo, hi
-
-    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
     return lo
 
 
@@ -168,15 +179,6 @@ class ConflictShapes:
     writes: int  # NW: total write ranges per batch
 
 
-def _f_commit(g, dep, c):
-    """One application of the batch-order commit operator.
-
-    f(c)[t] = g[t] and no earlier txn t1 with dep[t1,t] is in c.
-    """
-    blocked = jnp.any(dep & c[:, None], axis=0)
-    return g & ~blocked
-
-
 def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
                   max_write_life: int):
     """Pure function: (state, batch) -> (state', statuses, info). Jit-able.
@@ -208,8 +210,12 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     too_old = txn_valid & has_reads & (snapshot < oldest)
 
     # ---- 2. history check: range-max of step function vs snapshot ----
-    i0 = _searchsorted(bkeys, rb, "right") - 1  # segment containing begin
-    i1 = _searchsorted(bkeys, re, "left")  # first boundary >= end
+    # one fused bisection: [rb -> upper bound, re -> lower bound]
+    hist_q = jnp.concatenate([rb, re], axis=1)
+    hist_side = jnp.concatenate([jnp.ones(NR, bool), jnp.zeros(NR, bool)])
+    hist_idx = _searchsorted(bkeys, hist_q, hist_side)
+    i0 = hist_idx[:NR] - 1  # segment containing begin
+    i1 = hist_idx[NR:]  # first boundary >= end
     i0 = jnp.maximum(i0, 0)
     nonempty = _key_lt(rb, re)
     maxver = _range_max(table, i0, jnp.maximum(i1, i0 + 1))
@@ -218,6 +224,11 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
 
     # ---- 3. intra-batch: endpoint ranks -> pairwise overlap -> fixpoint ----
+    # The (T,T) dependency matrix of the first design required a 2D scatter
+    # (~170ms/batch on TPU); instead the fixpoint operates directly on the
+    # (NW, NR) range-overlap matrix via an MXU matvec: committed writes ->
+    # blocked reads is one bf16 matmul with exact f32 accumulation (0/1
+    # values), then a cheap 1D segment-max folds reads back to transactions.
     allk = jnp.concatenate([rb, re, wb, we], axis=1)  # (L, NA)
     NA = 2 * NR + 2 * NW
     ops = [allk[i] for i in range(L)] + [jnp.arange(NA, dtype=jnp.int32)]
@@ -231,21 +242,30 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     rbr, rer = ranks[:NR], ranks[NR:2 * NR]
     wbr, wer = ranks[2 * NR:2 * NR + NW], ranks[2 * NR + NW:]
 
-    # empty/inverted ranges (end <= begin) participate in neither side
+    # empty/inverted ranges (end <= begin) participate in neither side;
+    # strict wtxn < rtxn = "earlier txns win" (checkIntraBatchConflicts
+    # SkipList.cpp:1139-1152 processes in batch order)
     r_nonempty = rbr < rer
     w_nonempty = wbr < wer
     overlap = ((wbr[:, None] < rer[None, :]) & (rbr[None, :] < wer[:, None])
-               & (wvalid & w_nonempty)[:, None] & (rvalid & r_nonempty)[None, :])  # (NW, NR)
-    # dep[t1, t2]: t1's writes overlap t2's reads (scatter-max; padding -> slot T)
-    dep = jnp.zeros((T + 1, T + 1), bool)
-    dep = dep.at[wtxn[:, None], rtxn[None, :]].max(overlap)
-    dep = dep[:T, :T]
-    tri = jnp.arange(T)[:, None] < jnp.arange(T)[None, :]  # strict t1 < t2
-    dep = dep & tri
-
+               & (wvalid & w_nonempty)[:, None] & (rvalid & r_nonempty)[None, :]
+               & (wtxn[:, None] < rtxn[None, :]))  # (NW, NR)
+    ovf = overlap.astype(jnp.bfloat16)
     g = txn_valid & ~too_old & ~hist_conflict
+    wtxn_c = jnp.minimum(wtxn, T - 1)
+
+    def _f_commit(c):
+        """f(c)[t] = g[t] and no committed-in-c earlier txn's write overlaps
+        any of t's reads."""
+        cw = (c[wtxn_c] & wvalid).astype(jnp.bfloat16)
+        blocked_r = lax.dot_general(
+            cw[None, :], ovf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[0] > 0
+        blocked_t = (jnp.zeros(T + 1, bool).at[rtxn].max(blocked_r))[:T]
+        return g & ~blocked_t
+
     upper = g
-    lower = _f_commit(g, dep, upper)
+    lower = _f_commit(upper)
 
     def cond(lu):
         lower, upper = lu
@@ -253,10 +273,15 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
 
     def body(lu):
         lower, upper = lu
-        upper2 = _f_commit(g, dep, lower)
-        lower2 = _f_commit(g, dep, upper2)
+        upper2 = _f_commit(lower)
+        lower2 = _f_commit(upper2)
         return lower2, upper2
 
+    # typical dependency chains are shallow: unroll the first sandwich rounds
+    # (each device-loop iteration costs a sync) and fall back to the loop only
+    # for adversarially deep chains
+    for _ in range(2):
+        lower, upper = body((lower, upper))
     lower, upper = lax.while_loop(cond, body, (lower, upper))
     commit = lower
 
@@ -266,44 +291,99 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     statuses = jnp.where(txn_valid, statuses, COMMITTED)
 
     # ---- 4. merge surviving writes into the step function at vnew ----
+    # Incremental: only the 2NW candidate endpoints are sorted (the state's K
+    # boundaries are already sorted); the union is built by binary-searching
+    # each side into the other and scattering to merged positions. This
+    # replaces the original design's three full (K+2NW)-wide multi-limb sorts
+    # per batch with one 2NW-wide sort — the device analogue of the
+    # reference's finger-merge (mergeWriteConflictRanges SkipList.cpp:1260,
+    # which also only walks the *new* ranges).
     # committed, non-empty writes only: an inverted range would inject a
     # reversed -1/+1 coverage delta and cancel other writes' coverage
-    cw = wvalid & commit[jnp.minimum(wtxn, T - 1)] & _key_lt(wb, we)
-    cand = jnp.concatenate([wb, we], axis=1)  # (L, 2NW) candidate boundaries
+    cw = wvalid & commit[wtxn_c] & _key_lt(wb, we)
+    CU = 2 * NW
+    maxk = jnp.full((L, 1), jnp.uint32(0xFFFFFFFF))
+    cand = jnp.concatenate([wb, we], axis=1)  # (L, CU)
     cand_valid = jnp.concatenate([cw, cw])
-    # value at each candidate key under the current function
-    ci = jnp.maximum(_searchsorted(bkeys, cand, "right") - 1, 0)
-    cand_val = bval[ci]
+    cand = jnp.where(cand_valid[None, :], cand, maxk)
     # delta for coverage counting: +1 at committed write begins, -1 at ends
     cand_delta = jnp.concatenate(
         [cw.astype(jnp.int32), -(cw.astype(jnp.int32))])
-    # neutralize invalid candidates
-    maxk = jnp.full((L, 1), jnp.uint32(0xFFFFFFFF))
-    cand = jnp.where(cand_valid[None, :], cand, maxk)
-    cand_val = jnp.where(cand_valid, cand_val, NEG)
 
-    slot_valid = jnp.arange(K) < nb
-    allkeys = jnp.concatenate([jnp.where(slot_valid[None, :], bkeys, maxk), cand], axis=1)
-    allvals = jnp.concatenate([jnp.where(slot_valid, bval, NEG), cand_val])
-    alldelta = jnp.concatenate([jnp.zeros(K, jnp.int32), cand_delta])
-    KA = K + 2 * NW
-
-    ops = [allkeys[i] for i in range(L)] + [allvals, alldelta]
-    s = lax.sort(ops, num_keys=L)
+    # sort candidates (dead ones carry delta 0 and key maxk -> sort last)
+    s = lax.sort([cand[i] for i in range(L)] + [cand_delta], num_keys=L)
     skeys = jnp.stack(s[:L])
-    svals, sdelta = s[L], s[L + 1]
-    live = ~_key_eq(skeys, jnp.broadcast_to(maxk, skeys.shape))
+    sdelta = s[L]
+    live = sdelta != 0
     first = jnp.concatenate(
         [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])]) & live
-    grp = jnp.cumsum(first.astype(jnp.int32)) - 1  # key rank (garbage on dead)
-    grp = jnp.where(live, grp, KA - 1)
-    # per-rank coverage: sum deltas per key, then prefix over ranks
-    grp_delta = jnp.zeros(KA, jnp.int32).at[grp].add(jnp.where(live, sdelta, 0))
-    cover = jnp.cumsum(grp_delta)  # cover[r] > 0 => keys of rank r covered
-    # entries of the same key share the same value; keep group-first entries
-    gval = jnp.zeros(KA, jnp.int32).at[grp].max(jnp.where(live, svals, NEG))
-    covered = cover[grp] > 0
-    newval = jnp.where(covered, jnp.maximum(gval[grp], vnew), gval[grp])
+    grp = jnp.cumsum(first.astype(jnp.int32)) - 1  # unique-key rank
+    mc = jnp.sum(first.astype(jnp.int32))  # number of unique candidate keys
+    # unique representatives packed to ranks [0, mc); others -> dump slot CU.
+    # One int32 scatter + a gather instead of scattering the (L, .) limbs.
+    pos_rep = jnp.where(first, grp, CU)
+    rep_src = jnp.full(CU + 1, CU - 1, jnp.int32).at[pos_rep].set(
+        jnp.arange(CU, dtype=jnp.int32))[:CU]
+    ulive = jnp.arange(CU) < mc
+    ukeys = jnp.where(ulive[None, :], skeys[:, rep_src],
+                      jnp.uint32(0xFFFFFFFF))
+    gdelta = jnp.zeros(CU + 1, jnp.int32).at[jnp.where(live, grp, CU)].add(
+        jnp.where(live, sdelta, 0))[:CU]
+    # one fused bisection for both merge searches over the same queries:
+    # [upper bound (value lookup), lower bound (union position)]
+    mrg_q = jnp.concatenate([ukeys, ukeys], axis=1)
+    mrg_side = jnp.concatenate([jnp.ones(CU, bool), jnp.zeros(CU, bool)])
+    mrg_idx = _searchsorted(bkeys, mrg_q, mrg_side)
+    # value of each unique candidate key under the current step function
+    uval = bval[jnp.maximum(mrg_idx[:CU] - 1, 0)]
+
+    # union-merge positions: state key i -> i + (#new-unique candidates < it);
+    # candidate j -> (#state keys < it) + (#new-unique candidates before j).
+    # A candidate equal to a state key maps to the SAME slot (no new slot).
+    ia = mrg_idx[CU:]  # first state key >= cand
+    dup = _key_eq(bkeys[:, jnp.minimum(ia, K - 1)], ukeys) & (ia < nb)
+    is_new = ulive & ~dup
+    pre = jnp.cumsum(is_new.astype(jnp.int32)) - is_new.astype(jnp.int32)
+    pre_total = jnp.sum(is_new.astype(jnp.int32))
+    # new-unique candidates preceding each state key, WITHOUT a second binary
+    # search (K queries over the candidates would gather (L,K) per bisection
+    # step) and without a (K,)-wide gather: each new-unique candidate j
+    # counts for all state keys i >= ia[j] (+1 more if equal), so a
+    # scatter-add at ia[j]+dup[j] followed by a prefix sum gives the shift.
+    dmark = jnp.zeros(K + 1, jnp.int32).at[
+        jnp.where(is_new, ia + dup.astype(jnp.int32), K)].add(
+        jnp.where(is_new, 1, 0))
+    slotA = jnp.arange(K) + jnp.cumsum(dmark)[:K]
+    slotB = ia + pre
+    nu = nb + pre_total  # union size
+    KU = K + CU  # + 1 dump slot
+
+    # Build the union via ONE int32 source-index scatter + gathers: scattering
+    # the (L, ...) key limbs directly costs L scatter rows, while gathers of
+    # the same shape are cheap on TPU.
+    liveA = jnp.arange(K) < nb
+    posA = jnp.where(liveA, slotA, KU)
+    posB = jnp.where(ulive, slotB, KU)
+    src = jnp.full(KU + 1, -1, jnp.int32)
+    src = src.at[posA].set(jnp.arange(K, dtype=jnp.int32))
+    # B written second: a dup slot resolves to its candidate (same key; the
+    # candidate carries the coverage delta and an identical value)
+    src = src.at[posB].set(K + jnp.arange(CU, dtype=jnp.int32))
+    is_b = src >= K
+    src_c = jnp.clip(src, 0, K + CU - 1)
+    # one fused value/delta lookup over a concatenated [state | candidate]
+    # table instead of two separate per-source gathers + select
+    vtab = jnp.concatenate([bval, uval])
+    dtab = jnp.concatenate([jnp.zeros(K, jnp.int32), gdelta])
+    val_u = jnp.where(src >= 0, vtab[src_c], NEG)
+    delta_u = jnp.where(is_b, dtab[src_c], 0)
+
+    # coverage: prefix-sum of deltas in key order; >0 => segment covered by a
+    # committed write of this batch, so its version becomes vnew
+    cover = jnp.cumsum(delta_u) > 0
+    idxu = jnp.arange(KU + 1)
+    live_u = idxu < nu
+    newval = jnp.where(cover & live_u, jnp.maximum(val_u, vnew), val_u)
 
     # ---- 5. window GC: clamp to new floor + coalesce equal neighbors ----
     # advance_floor is False for all but the last chunk of a logical batch:
@@ -313,32 +393,33 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     floor = jnp.where(batch["advance_floor"],
                       vnew - jnp.int32(max_write_life), oldest)
     new_oldest = jnp.maximum(oldest, floor)
-    newval = jnp.maximum(newval, new_oldest)
+    newval = jnp.where(live_u, jnp.maximum(newval, new_oldest), NEG)
 
-    keep = first
-    # compact kept entries to the front (stable sort by drop flag)
-    dropk = (~keep).astype(jnp.int32)
-    ops = [dropk] + [skeys[i] for i in range(L)] + [newval]
-    c = lax.sort(ops, num_keys=1, is_stable=True)
-    ckeys = jnp.stack(c[1:1 + L])
-    cvals = c[1 + L]
-    n1 = jnp.sum(keep.astype(jnp.int32))
-    # coalesce: segment i redundant if value equals previous kept value
-    idx = jnp.arange(KA)
-    prev_val = jnp.concatenate([jnp.full(1, NEG, jnp.int32), cvals[:-1]])
-    keep2 = (idx < n1) & ((idx == 0) | (cvals != prev_val))
-    dropk2 = (~keep2).astype(jnp.int32)
-    ops = [dropk2] + [ckeys[i] for i in range(L)] + [cvals]
-    c2 = lax.sort(ops, num_keys=1, is_stable=True)
-    fkeys = jnp.stack(c2[1:1 + L])
-    fvals = c2[1 + L]
+    # coalesce (removeBefore's segment-merge analogue): a slot is redundant
+    # if its value equals its predecessor's post-clamp value
+    prev_val = jnp.concatenate([jnp.full(1, NEG, jnp.int32), newval[:-1]])
+    keep2 = live_u & ((idxu == 0) | (newval != prev_val))
     n2 = jnp.sum(keep2.astype(jnp.int32))
+    # compact kept slots to the front: one int32 source scatter, then gather
+    # keys directly from their ORIGINAL arrays (state / unique candidates)
+    # through the composed index — the union's key array is never
+    # materialized at all.
+    cpos = jnp.cumsum(keep2.astype(jnp.int32)) - 1
+    cpos = jnp.where(keep2, jnp.minimum(cpos, K - 1), K)
+    csrc = jnp.full(K + 1, -1, jnp.int32).at[cpos].set(
+        jnp.arange(KU + 1, dtype=jnp.int32))[:K]
+    kept = csrc >= 0
+    csrc_c = jnp.clip(csrc, 0, KU)
+    fsrc = src[csrc_c]  # source id of each final slot (composed)
+    f_is_a = kept & (fsrc >= 0) & (fsrc < K)
+    f_is_b = kept & (fsrc >= K)
+    out_keys = jnp.where(
+        f_is_a[None, :], bkeys[:, jnp.clip(fsrc, 0, K - 1)],
+        jnp.where(f_is_b[None, :], ukeys[:, jnp.clip(fsrc - K, 0, CU - 1)],
+                  jnp.uint32(0xFFFFFFFF)))
+    out_vals = jnp.where(kept, newval[csrc_c], NEG)
 
     overflow = n2 > K
-    out_keys = fkeys[:, :K]
-    out_vals = jnp.where(jnp.arange(K) < n2, fvals[:K], NEG)
-    out_keys = jnp.where((jnp.arange(K) < n2)[None, :], out_keys,
-                         jnp.broadcast_to(maxk, (L, K)))
 
     # Overflow poisons the state (sticky): truncation would drop the
     # highest-key history segments and cause FALSE COMMITS for batches
@@ -408,6 +489,32 @@ def _compiled_step(shapes: ConflictShapes, max_write_life: int):
     """One compiled program per (shapes, window) — shared across instances."""
     return jax.jit(functools.partial(
         conflict_step, shapes=shapes, max_write_life=max_write_life))
+
+
+def conflict_scan(state: dict, stacked: dict, *, shapes: ConflictShapes,
+                  max_write_life: int):
+    """Run M conflict batches in ONE device dispatch via lax.scan.
+
+    `stacked` has the same fields as a conflict_step batch with a leading
+    batch axis (M, ...). Returns (final_state, statuses (M, T) int8,
+    committed (M,) int32, overflow (M,) bool). Dispatch overhead (several ms
+    per program launch through the runtime) amortizes over M batches — the
+    device analogue of the proxy's pipelined commitBatch gating
+    (MasterProxyServer.actor.cpp:364-366).
+    """
+    def stepfn(st, batch):
+        st2, statuses, info = conflict_step(
+            st, batch, shapes=shapes, max_write_life=max_write_life)
+        return st2, (statuses.astype(jnp.int8), info["committed"],
+                     info["overflow"])
+    final, (stat, comm, ovf) = lax.scan(stepfn, state, stacked)
+    return final, stat, comm, ovf
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_scan(shapes: ConflictShapes, max_write_life: int):
+    return jax.jit(functools.partial(
+        conflict_scan, shapes=shapes, max_write_life=max_write_life))
 
 
 def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,

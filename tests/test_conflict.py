@@ -297,3 +297,40 @@ def test_state_survives_many_batches_with_gc():
         check(dev, oracle, txns, version)
     # GC must keep the boundary count bounded by the live key space
     assert int(dev._state["nb"]) <= 2 * len(space) + 2
+
+
+def test_full_capacity_merge_above_all_boundaries():
+    """Regression: with the state exactly full (nb == K), committing a write
+    above every stored boundary must still record BOTH endpoints. A surplus
+    bisection step used to return K+1 for past-the-end queries, shifting the
+    union slots right and silently dropping the write's end boundary
+    (persistent false conflicts, or a broken sorted invariant)."""
+    cs = DeviceConflictSet(capacity=4, txns=4, reads_per_txn=1,
+                           writes_per_txn=1)
+    version = 1000
+    # fill the state to exactly K=4 boundaries ("", k10, k20, k30): adjacent
+    # writes at distinct versions share interior boundaries
+    for lo, hi in ((10, 20), (20, 30)):
+        txns = [TxnConflictInfo(
+            read_snapshot=version - 1, read_ranges=[],
+            write_ranges=[(lo.to_bytes(4, "big"), hi.to_bytes(4, "big"))])]
+        version += 1
+        assert cs.detect(txns, version) == [COMMITTED]
+    # commit a write above all boundaries while window GC coalesces the old
+    # segments (large version jump keeps within int32 offsets)
+    version += 6_000_000
+    w = TxnConflictInfo(read_snapshot=version - 1, read_ranges=[],
+                        write_ranges=[(int(100).to_bytes(4, "big"),
+                                       int(200).to_bytes(4, "big"))])
+    assert cs.detect([w], version) == [COMMITTED]
+    # a read strictly above the write's end must NOT see it
+    r_above = TxnConflictInfo(read_snapshot=version - 1,
+                              read_ranges=[(int(200).to_bytes(4, "big"),
+                                            int(300).to_bytes(4, "big"))],
+                              write_ranges=[])
+    # a read overlapping the write must conflict
+    r_hit = TxnConflictInfo(read_snapshot=version - 1,
+                            read_ranges=[(int(150).to_bytes(4, "big"),
+                                          int(160).to_bytes(4, "big"))],
+                            write_ranges=[])
+    assert cs.detect([r_above, r_hit], version + 1) == [COMMITTED, CONFLICT]
