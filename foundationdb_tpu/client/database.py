@@ -22,15 +22,31 @@ from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.rng import DeterministicRandom
 
 
+# Errors that mean "the cluster moved under us": refresh the cluster layout
+# from the coordinators and retry (NativeAPI's monitorClientInfo reaction to
+# proxy failure; proxies_changed/broken_promise handling in tryCommit).
+_CLUSTER_ERRORS = frozenset({
+    "broken_promise", "cluster_not_fully_recovered", "tlog_stopped",
+    "coordinators_changed", "timed_out", "commit_unknown_result",
+})
+
+
 class Database:
-    def __init__(self, process: SimProcess, proxies: list[str],
-                 storage_for_key, rng: DeterministicRandom | None = None):
+    def __init__(self, process: SimProcess, proxies: list[str] | None = None,
+                 storage_for_key=None, rng: DeterministicRandom | None = None,
+                 coordinators: list[str] | None = None):
         """`storage_for_key(key) -> address` is the location cache stand-in;
-        with data distribution it becomes a real cached shard map."""
+        with data distribution it becomes a real cached shard map.
+
+        With `coordinators`, the client discovers (and re-discovers, after
+        recoveries) the proxy list and storage layout through the elected
+        cluster controller's DBInfo — the cluster-file path of the reference
+        (MonitorLeader.actor.cpp + monitorClientInfo, NativeAPI:497)."""
         self.process = process
         self.loop = process.net.loop
-        self.proxies = proxies  # proxy process addresses
+        self.proxies = list(proxies or [])  # proxy process addresses
         self.storage_for_key = storage_for_key
+        self.coordinators = list(coordinators or [])
         self._rng = rng or DeterministicRandom(0xDB)
         self._grv_waiters: list[Future] = []
         self._grv_armed = False
@@ -40,7 +56,8 @@ class Database:
 
     async def transact(self, fn, max_retries: int = 100):
         """Run `await fn(tr)` then commit, retrying per onError — the
-        @fdb.transactional contract."""
+        @fdb.transactional contract. Cluster-layout errors trigger a
+        coordinator-driven refresh before the retry."""
         tr = self.create_transaction()
         for _ in range(max_retries):
             try:
@@ -48,12 +65,57 @@ class Database:
                 await tr.commit()
                 return result
             except FDBError as e:
+                if self.coordinators and e.name in _CLUSTER_ERRORS:
+                    try:
+                        await self.refresh()
+                    except FDBError as re:
+                        if re.name == "operation_cancelled":
+                            raise
+                        # no recovered cluster yet: burn one retry and keep
+                        # trying — a slow recovery is a retryable condition
+                    tr = self.create_transaction()
+                    continue
                 await tr.on_error(e)  # re-raises when not retryable
         raise FDBError("operation_failed", "transact: retry limit exhausted")
+
+    async def refresh(self, max_wait: float = 30.0):
+        """Re-resolve the cluster layout via the coordinators: leader ->
+        DBInfo -> proxies + shard map. Blocks (bounded) until a recovered
+        generation is available."""
+        from foundationdb_tpu.core.sim import Endpoint
+        from foundationdb_tpu.server.coordination import get_leader
+        from foundationdb_tpu.server.interfaces import Token
+        from foundationdb_tpu.utils.keys import partition_index
+
+        deadline = self.loop.now() + max_wait
+        while self.loop.now() < deadline:
+            try:
+                leader = await get_leader(self.process, self.coordinators)
+                if leader:
+                    info = await self.loop.timeout(self.process.net.request(
+                        self.process, Endpoint(leader, Token.CC_GET_DBINFO),
+                        None), 2.0)
+                    if info.recovery_state == "accepting_commits" and info.proxies:
+                        self.proxies = list(info.proxies)
+                        addr_of_tag = {tag: addr for addr, tag in info.storages}
+                        boundaries = list(info.shard_boundaries)
+
+                        def storage_for_key(key: bytes) -> str:
+                            return addr_of_tag[partition_index(boundaries, key)]
+
+                        self.storage_for_key = storage_for_key
+                        return
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+            await self.loop.delay(0.5)
+        raise FDBError("coordinators_changed", "no recovered cluster found")
 
     # -- RPC plumbing used by Transaction --
 
     def _pick_proxy(self, token: int) -> Endpoint:
+        if not self.proxies:
+            raise FDBError("cluster_not_fully_recovered", "no proxies known")
         addr = self.proxies[self._rng.randint(0, len(self.proxies) - 1)]
         return Endpoint(addr, token)
 
@@ -82,18 +144,23 @@ class Database:
                 if not w.is_ready():
                     w._set_error(FDBError(e.name, e.detail))
 
+    def _storage_addr(self, key: bytes) -> str:
+        if self.storage_for_key is None:
+            raise FDBError("cluster_not_fully_recovered", "no layout known")
+        return self.storage_for_key(key)
+
     def _get_value(self, req: GetValueRequest) -> Future:
-        ep = Endpoint(self.storage_for_key(req.key), Token.STORAGE_GET_VALUE)
+        ep = Endpoint(self._storage_addr(req.key), Token.STORAGE_GET_VALUE)
         return self.process.net.request(self.process, ep, req)
 
     def _get_range(self, req: GetKeyValuesRequest) -> Future:
         # single-shard for now: the begin selector's owner serves the range
-        ep = Endpoint(self.storage_for_key(req.begin.key),
+        ep = Endpoint(self._storage_addr(req.begin.key),
                       Token.STORAGE_GET_KEY_VALUES)
         return self.process.net.request(self.process, ep, req)
 
     def _watch(self, req: WatchValueRequest) -> Future:
-        ep = Endpoint(self.storage_for_key(req.key), Token.STORAGE_WATCH_VALUE)
+        ep = Endpoint(self._storage_addr(req.key), Token.STORAGE_WATCH_VALUE)
         return self.process.net.request(self.process, ep, req)
 
     def _commit(self, req) -> Future:

@@ -106,6 +106,9 @@ class ActorTask(Future):
         self._step()
 
     def _step(self):
+        if self.is_ready():
+            return  # died meanwhile (e.g. a cancel landed between a queued
+            # resume and now): a finished coroutine must never be re-driven
         self._drive(lambda: self._coro.send(None))
 
     def _drive(self, advance):

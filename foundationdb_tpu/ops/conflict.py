@@ -347,12 +347,12 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     pre_total = jnp.sum(is_new.astype(jnp.int32))
     # new-unique candidates preceding each state key, WITHOUT a second binary
     # search (K queries over the candidates would gather (L,K) per bisection
-    # step) and without a (K,)-wide gather: each new-unique candidate j
-    # counts for all state keys i >= ia[j] (+1 more if equal), so a
-    # scatter-add at ia[j]+dup[j] followed by a prefix sum gives the shift.
+    # step) and without a (K,)-wide gather: a new-unique candidate j is
+    # strictly below exactly the state keys i >= ia[j] (new means not equal
+    # to any state key), so a scatter-add at ia[j] followed by a prefix sum
+    # gives each state key's slot shift.
     dmark = jnp.zeros(K + 1, jnp.int32).at[
-        jnp.where(is_new, ia + dup.astype(jnp.int32), K)].add(
-        jnp.where(is_new, 1, 0))
+        jnp.where(is_new, ia, K)].add(jnp.where(is_new, 1, 0))
     slotA = jnp.arange(K) + jnp.cumsum(dmark)[:K]
     slotB = ia + pre
     nu = nb + pre_total  # union size

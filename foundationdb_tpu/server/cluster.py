@@ -114,3 +114,97 @@ class SimCluster:
         from foundationdb_tpu.core.future import all_of
         tasks = [self.loop.spawn(c, name=f"test{i}") for i, c in enumerate(coros)]
         return self.run(all_of(tasks), max_time=max_time)
+
+
+class RecoverableCluster:
+    """A cluster built the real way: coordinators + workers, with the
+    transaction subsystem recruited by an ELECTED cluster controller and
+    rebuilt from scratch on any role failure (SURVEY §3.3).
+
+    Unlike SimCluster (direct construction, used by the steady-state tests),
+    nothing here is wired by hand: workers register with the leader, the
+    recovery state machine locks the old TLog generation, recruits a new one,
+    writes the coordinated state, and rebinds storage servers.
+    """
+
+    def __init__(self, seed: int = 0, n_coordinators: int = 3,
+                 n_workers: int = 5, n_proxies: int = 2, n_resolvers: int = 1,
+                 n_tlogs: int = 2, n_storage: int = 2):
+        from foundationdb_tpu.server.clustercontroller import (
+            ClusterConfig, ClusterController)
+        from foundationdb_tpu.server.coordination import Coordinator, elect_leader
+        from foundationdb_tpu.server.worker import Worker
+
+        self.loop = EventLoop()
+        self.rng = DeterministicRandom(seed)
+        self.net = SimNetwork(self.loop, self.rng.fork())
+        self.config = ClusterConfig(n_proxies=n_proxies,
+                                    n_resolvers=n_resolvers,
+                                    n_tlogs=n_tlogs, n_storage=n_storage)
+
+        self.coord_procs = [self.net.new_process(f"coord:{i}")
+                            for i in range(n_coordinators)]
+        self.coordinators = [p.address for p in self.coord_procs]
+        self.coords = [Coordinator(p) for p in self.coord_procs]
+        for p in self.coord_procs:
+            def boot_coord(proc):
+                Coordinator(proc)
+            p.boot_fn = boot_coord
+
+        # process classes (fdbrpc/Locality.h ProcessClass): the disposable
+        # transaction subsystem lives on stateless/tlog workers; storage
+        # servers (the only roles with irreplaceable single-replica state
+        # until replication lands) get dedicated workers, so killing a txn
+        # role never destroys a shard
+        self.worker_procs = [self.net.new_process(f"worker:{i}")
+                             for i in range(n_workers)]
+        self.storage_worker_procs = [self.net.new_process(f"storagew:{i}")
+                                     for i in range(n_storage)]
+
+        def start_worker(proc: SimProcess):
+            proc.worker = Worker(proc, self.coordinators,
+                                 ["stateless", "tlog"])
+
+            async def cc_candidate():
+                # tryBecomeLeader loop: whoever wins runs the CC/recovery
+                # core until deposed, then campaigns again
+                while True:
+                    await elect_leader(proc, self.coordinators, priority=1)
+                    cc = ClusterController(proc, self.coordinators, self.config)
+                    proc.cluster_controller = cc
+                    await cc.run()
+
+            proc.spawn(cc_candidate(), "ccCandidate")
+
+        def start_storage_worker(proc: SimProcess):
+            proc.worker = Worker(proc, self.coordinators, ["storage"])
+
+        for p in self.worker_procs:
+            p.boot_fn = start_worker
+            start_worker(p)
+        for p in self.storage_worker_procs:
+            p.boot_fn = start_storage_worker
+            start_storage_worker(p)
+
+    def database(self, name: str = "client:0") -> Database:
+        proc = self.net.processes.get(name) or self.net.new_process(name)
+        return Database(proc, coordinators=self.coordinators,
+                        rng=self.rng.fork())
+
+    def run(self, future, max_time: float = 1000.0):
+        return self.loop.run_future(future, max_time=max_time)
+
+    def run_all(self, coros, max_time: float = 1000.0):
+        from foundationdb_tpu.core.future import all_of
+        tasks = [self.loop.spawn(c, name=f"test{i}") for i, c in enumerate(coros)]
+        return self.run(all_of(tasks), max_time=max_time)
+
+    # -- introspection for tests --
+
+    def current_cc(self):
+        for p in self.worker_procs:
+            cc = getattr(p, "cluster_controller", None)
+            if cc is not None and p.alive and not cc.deposed \
+                    and cc.dbinfo.recovery_state == "accepting_commits":
+                return cc
+        return None

@@ -1,0 +1,370 @@
+"""ClusterController: elected leader that drives master recovery.
+
+Reference: fdbserver/ClusterController.actor.cpp (worker registry, recruitment
+:383, ServerDBInfo broadcast) + fdbserver/masterserver.actor.cpp (masterCore
+:1160, recoverFrom :759) + fdbserver/TagPartitionedLogSystem.actor.cpp
+(epochEnd :398-417). The reference splits the recovery driver into a recruited
+master role babysat by the CC; here the CC runs the recovery state machine
+itself and recruits the *version-allocator* master as a worker role — the
+fitness/preemption machinery (betterMasterExists :799) is not modeled yet.
+
+Recovery states (RecoveryState.h:30):
+  READING_CSTATE  — quorum-read the coordinated state (prior log system)
+  LOCKING_CSTATE  — lock the old TLog generation; compute the recovery version
+  RECRUITING      — instantiate a whole new transaction subsystem on workers
+  WRITING_CSTATE  — publish the new log-system config through the coordinators
+  ACCEPTING_COMMITS — broadcast DBInfo + SetLogSystem; monitor for failure
+
+The transaction subsystem is disposable: ANY master/proxy/resolver/TLog
+failure triggers a fresh recovery with a new epoch; storage servers survive
+across epochs and roll back to the recovery version (storageserver rollback
+:2211 via SetLogSystemRequest).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.server.coordination import (
+    CandidacyRequest, CoordinatedStateClient, CoordToken, quorum_wait)
+from foundationdb_tpu.server.interfaces import (
+    DBInfo, InitRoleRequest, LogEpoch, RegisterWorkerRequest,
+    SetLogSystemRequest, TLogLockRequest, Token)
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+@dataclass
+class ClusterConfig:
+    n_proxies: int = 1
+    n_resolvers: int = 1
+    n_tlogs: int = 1
+    n_storage: int = 1
+
+
+def _partition_boundaries(n: int) -> list[bytes]:
+    if n <= 1:
+        return [b""]
+    return [b""] + [bytes([int(256 * i / n)]) for i in range(1, n)]
+
+
+@dataclass
+class _Registry:
+    """Known workers: address -> (capabilities, last_seen)."""
+
+    workers: dict = field(default_factory=dict)
+
+    def register(self, req: RegisterWorkerRequest, now: float):
+        self.workers[req.address] = (list(req.roles), now)
+
+    def alive(self, capability: str, now: float, max_age: float = 3.0) -> list[str]:
+        return sorted(a for a, (caps, seen) in self.workers.items()
+                      if capability in caps and now - seen <= max_age)
+
+
+class ClusterController:
+    def __init__(self, process: SimProcess, coordinators: list[str],
+                 config: ClusterConfig):
+        self.process = process
+        self.net = process.net
+        self.loop = process.net.loop
+        self.coordinators = coordinators
+        self.config = config
+        self.registry = _Registry()
+        self.cstate = CoordinatedStateClient(process, coordinators)
+        self.dbinfo = DBInfo(version=0, epoch=0, master=None, proxies=[],
+                             resolvers=[], log_epochs=[], storages=[],
+                             shard_boundaries=[], recovery_state="unrecovered")
+        self.deposed = False
+        self._need_recovery = Future()
+        self._watchers: list = []
+        process.register(Token.CC_REGISTER_WORKER, self._on_register)
+        process.register(Token.CC_GET_DBINFO, self._on_get_dbinfo)
+
+    def _on_register(self, req: RegisterWorkerRequest, reply):
+        self.registry.register(req, self.loop.now())
+        reply.send(None)
+
+    def _on_get_dbinfo(self, req, reply):
+        reply.send(self.dbinfo)
+
+    # -- leadership maintenance (tryBecomeLeader's nominee refresh) --
+
+    async def _hold_leadership(self):
+        quorum = len(self.coordinators) // 2 + 1
+        while True:
+            votes = 0
+            for addr in self.coordinators:
+                try:
+                    r = await self.loop.timeout(self.net.request(
+                        self.process, Endpoint(addr, CoordToken.CANDIDACY),
+                        CandidacyRequest(address=self.process.address,
+                                         priority=1)), 1.0)
+                    if r.leader == self.process.address:
+                        votes += 1
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+            if votes < quorum:
+                self.deposed = True
+                if not self._need_recovery.is_ready():
+                    self._need_recovery._set("deposed")
+                return
+            await self.loop.delay(1.0)
+
+    # -- role failure detection (waitFailureClient analogue) --
+
+    async def _watch_role(self, address: str, what: str):
+        misses = 0
+        while True:
+            try:
+                await self.loop.timeout(self.net.request(
+                    self.process, Endpoint(address, Token.WORKER_PING), None),
+                    1.0)
+                misses = 0
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                misses += 1
+                if misses >= 2:
+                    TraceEvent("CCRoleFailed", self.process.address) \
+                        .detail("Role", what).detail("Address", address).log()
+                    if not self._need_recovery.is_ready():
+                        self._need_recovery._set(f"{what}@{address}")
+                    return
+            await self.loop.delay(0.5)
+
+    # -- the recovery state machine --
+
+    async def run(self):
+        """Drive recoveries until deposed (clusterControllerCore)."""
+        hold = self.process.spawn(self._hold_leadership(), "holdLeadership")
+        try:
+            while not self.deposed:
+                try:
+                    await self._recover_once()
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                    TraceEvent("CCRecoveryFailed", self.process.address) \
+                        .detail("Error", e.name).detail("Detail", e.detail).log()
+                    await self.loop.delay(0.5)
+                    continue
+                # recovered: wait for a role failure or deposition
+                reason = await self._need_recovery
+                self._need_recovery = Future()
+                TraceEvent("CCRecoveryTriggered", self.process.address) \
+                    .detail("Reason", str(reason)).log()
+        finally:
+            hold.cancel()
+            for w in self._watchers:  # a deposed CC stops babysitting
+                w.cancel()
+            self._watchers = []
+
+    async def _recover_once(self):
+        cfg = self.config
+        # stop babysitting the generation being replaced (a locked old TLog
+        # dying later must not trigger a spurious recovery)
+        for w in self._watchers:
+            w.cancel()
+        self._watchers = []
+        # ---- READING_CSTATE ----
+        self.dbinfo.recovery_state = "reading_cstate"
+        prior, _gen = await self.cstate.read()
+
+        # ---- LOCKING_CSTATE: epoch end over the old generation ----
+        self.dbinfo.recovery_state = "locking_cstate"
+        if prior is None:
+            epoch = 1
+            recovery_version = 0
+            old_epochs: list[LogEpoch] = []
+            storages: list[tuple[str, int]] = []
+            boundaries = _partition_boundaries(cfg.n_storage)
+        else:
+            epoch = prior["epoch"] + 1
+            old_epochs = list(prior["log_epochs"])
+            storages = list(prior["storages"])
+            boundaries = list(prior["shard_boundaries"])
+            recovery_version = await self._lock_old_generation(old_epochs[-1])
+            # close the old generation at the recovery version
+            old_epochs[-1] = LogEpoch(begin=old_epochs[-1].begin,
+                                      end=recovery_version,
+                                      addrs=old_epochs[-1].addrs,
+                                      epoch=old_epochs[-1].epoch)
+
+        # the new generation starts above anything any process can have seen
+        # in flight (masterserver.actor.cpp:858 bump)
+        start_version = recovery_version + KNOBS.MAX_VERSIONS_IN_FLIGHT
+
+        # ---- RECRUITING ----
+        self.dbinfo.recovery_state = "recruiting"
+        now = self.loop.now()
+        stateless = self.registry.alive("stateless", now)
+        log_workers = self.registry.alive("tlog", now)
+        if not stateless or len(log_workers) < cfg.n_tlogs:
+            raise FDBError("recruitment_failed", "not enough workers")
+
+        # new TLog generation: fresh instances with epoch-suffixed files so an
+        # old locked generation's disk state is never reused
+        tlog_addrs = await self._recruit_many(
+            log_workers, cfg.n_tlogs, "tlog",
+            lambda i: {"epoch": epoch, "recovery_version": start_version,
+                       "file_name": f"tlog-e{epoch}.dq"})
+        new_epochs = old_epochs + [LogEpoch(begin=recovery_version, end=None,
+                                            addrs=tlog_addrs, epoch=epoch)]
+
+        resolver_addrs = await self._recruit_many(
+            stateless, cfg.n_resolvers, "resolver",
+            lambda i: {"recovery_version": start_version})
+        master_addr = (await self._recruit_many(
+            stateless, 1, "master",
+            lambda i: {"recovery_version": start_version, "epoch": epoch,
+                       "coordinators": list(self.coordinators)}))[0]
+
+        if prior is None:
+            storage_workers = self.registry.alive("storage", now)
+            if len(storage_workers) < cfg.n_storage:
+                raise FDBError("recruitment_failed", "not enough storage workers")
+            storages = []
+            for i in range(cfg.n_storage):
+                addr = (await self._recruit_many(
+                    [storage_workers[i % len(storage_workers)]], 1, "storage",
+                    lambda _i, i=i: {"tag": i, "log_epochs": list(new_epochs),
+                                     "recovery_count": epoch}))[0]
+                storages.append((addr, i))
+
+        from foundationdb_tpu.server.proxy import ResolverMap, ShardMap
+        shard_map = ShardMap(boundaries=boundaries,
+                             tags=[[i] for i in range(cfg.n_storage)])
+        resolver_map = ResolverMap(
+            boundaries=_partition_boundaries(cfg.n_resolvers),
+            endpoints=[Endpoint(a, Token.RESOLVER_RESOLVE)
+                       for a in resolver_addrs])
+        # worker address == role address, so the cross-proxy GRV confirmation
+        # set (getLiveCommittedVersion :935) is known before recruitment
+        proxy_addrs = [stateless[i % len(stateless)]
+                       for i in range(cfg.n_proxies)]
+        for i in range(cfg.n_proxies):
+            await self._recruit_many(
+                [proxy_addrs[i]], 1, "proxy",
+                lambda _i, i=i: {
+                    "proxy_id": i,
+                    "master": Endpoint(master_addr, Token.MASTER_GET_COMMIT_VERSION),
+                    "resolvers": resolver_map,
+                    "tlogs": [Endpoint(a, Token.TLOG_COMMIT) for a in tlog_addrs],
+                    "shards": shard_map,
+                    "recovery_version": start_version,
+                    "epoch": epoch,
+                    "other_proxies": [a for a in proxy_addrs
+                                      if a != proxy_addrs[i]],
+                })
+
+        # ---- WRITING_CSTATE: fencing point for competing recoveries ----
+        self.dbinfo.recovery_state = "writing_cstate"
+        await self.cstate.write({
+            "epoch": epoch,
+            "master": master_addr,
+            "log_epochs": new_epochs,
+            "storages": storages,
+            "shard_boundaries": boundaries,
+            "recovery_version": recovery_version,
+        })
+
+        # ---- ACCEPTING_COMMITS: rebind storages, publish DBInfo ----
+        for addr, _tag in storages:
+            self.net.one_way(self.process,
+                             Endpoint(addr, Token.STORAGE_SET_LOGSYSTEM),
+                             SetLogSystemRequest(epochs=list(new_epochs),
+                                                 rollback_to=recovery_version,
+                                                 recovery_count=epoch))
+        if prior is not None:
+            # fence the old generation's read versions before clients can see
+            # (and commit through) the new one. Fast path: depose the old
+            # master directly. Backstop for partitions: the old master's own
+            # cstate lease fails within MASTER_CSTATE_LEASE once the cstate
+            # has moved (or its coordinator quorum is gone), and its proxies'
+            # GRV leases drain within PROXY_MASTER_LEASE after that — so wait
+            # out both before publishing DBInfo (the reference gets this from
+            # the old master's cstate writes failing + proxy failure
+            # monitoring; strict serializability needs no old-generation GRV
+            # after the first new-generation commit).
+            old_master = prior.get("master")
+            if old_master:
+                self.net.one_way(self.process,
+                                 Endpoint(old_master, Token.MASTER_DEPOSE),
+                                 epoch)
+            await self.loop.delay(1.5 * KNOBS.MASTER_CSTATE_LEASE_SECONDS
+                                  + KNOBS.PROXY_MASTER_LEASE_SECONDS)
+        self.dbinfo = DBInfo(
+            version=self.dbinfo.version + 1, epoch=epoch, master=master_addr,
+            proxies=proxy_addrs, resolvers=resolver_addrs,
+            log_epochs=new_epochs, storages=storages,
+            shard_boundaries=boundaries, recovery_state="accepting_commits")
+        TraceEvent("CCRecovered", self.process.address) \
+            .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
+            .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()
+
+        # babysit the new generation
+        for addr in sorted(set([master_addr] + proxy_addrs + resolver_addrs
+                               + tlog_addrs)):
+            self._watchers.append(
+                self.process.spawn(self._watch_role(addr, "txn"), "watchRole"))
+
+    async def _lock_old_generation(self, old: LogEpoch) -> int:
+        """epochEnd (TagPartitionedLogSystem:398-417): lock enough old TLogs
+        that no old-generation commit can reach quorum again, then choose the
+        recovery version.
+
+        With commit quorum N - a (antiquorum a), locking a+1 logs fences the
+        generation. For the recovery version we use the (s-a)-th highest
+        durable version over the s locked logs: any acknowledged commit is
+        durable on >= N-a logs, so at least s-a locked logs hold it and the
+        (s-a)-th highest durable version is >= every acked commit. With the
+        default a=0 this is min-over-locked, which every locked log holds in
+        full (so the data for every recovered version is reachable)."""
+        # the SAME antiquorum the proxies commit with (proxy.py quorum =
+        # len(tlogs) - TLOG_QUORUM_ANTIQUORUM): the fencing and recovery-
+        # version math below is only sound against the real commit quorum
+        a = KNOBS.TLOG_QUORUM_ANTIQUORUM
+        futures = [self.loop.timeout(self.net.request(
+            self.process, Endpoint(addr, Token.TLOG_LOCK),
+            TLogLockRequest(epoch=old.epoch)), 2.0) for addr in old.addrs]
+        # a+1 locked logs fence the old generation (the alive unlocked
+        # remainder is below the N-a commit quorum) and suffice for safety:
+        # any acked commit is durable on >= N-a logs, so >= s-a of any s
+        # locked logs hold it. Locking MORE when available only improves the
+        # data's reachability, so collect every answer (bounded by the
+        # per-request timeouts already attached).
+        need = a + 1
+        replies = []
+        for f in futures:
+            try:
+                replies.append(await f)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+        if len(replies) < need:
+            raise FDBError("master_tlog_failed",
+                           "cannot lock enough old TLogs")
+        durables = sorted((r.durable_version for r in replies), reverse=True)
+        s = len(durables)
+        recovery_version = durables[max(0, s - a - 1)]
+        return recovery_version
+
+    async def _recruit_many(self, workers: list[str], n: int, role: str,
+                            make_args) -> list[str]:
+        addrs = []
+        for i in range(n):
+            addr = workers[i % len(workers)]
+            try:
+                r = await self.loop.timeout(self.net.request(
+                    self.process, Endpoint(addr, Token.WORKER_INIT_ROLE),
+                    InitRoleRequest(role=role, args=make_args(i))), 2.0)
+                addrs.append(r.address)
+            except FDBError as e:
+                raise FDBError("recruitment_failed",
+                               f"{role} on {addr}: {e.name}") from None
+        return addrs

@@ -35,6 +35,7 @@ class CoordToken:
     GENERATION_WRITE = 61
     CANDIDACY = 62
     GET_LEADER = 63
+    GENERATION_PEEK = 64  # read-only: no rgen promotion, no ballot needed
 
 
 @dataclass
@@ -135,11 +136,19 @@ class Coordinator:
         process.register(CoordToken.GENERATION_WRITE, self._on_write)
         process.register(CoordToken.CANDIDACY, self._on_candidacy)
         process.register(CoordToken.GET_LEADER, self._on_get_leader)
+        process.register(CoordToken.GENERATION_PEEK, self._on_peek)
 
     def _persist(self):
         import pickle
         self.store.set_metadata("regs", pickle.dumps(self._regs))
         self.store.commit()
+
+    def _on_peek(self, req: GenReadRequest, reply):
+        """Read-only register peek: observers (e.g. a master checking whether
+        its generation is still current) must not promote rgen, or they would
+        force live CoordinatedState writers into ballot retries."""
+        value, vgen, rgen = self._regs.get(req.key, (None, 0, 0))
+        reply.send(GenReadReply(value=value, vgen=vgen, rgen=rgen))
 
     def _on_read(self, req: GenReadRequest, reply):
         value, vgen, rgen = self._regs.get(req.key, (None, 0, 0))

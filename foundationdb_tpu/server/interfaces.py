@@ -21,6 +21,8 @@ from foundationdb_tpu.utils.types import Mutation
 # Well-known endpoint tokens (fdbrpc/FlowTransport.h WLTOKEN_* pattern).
 class Token:
     MASTER_GET_COMMIT_VERSION = 1
+    MASTER_PING = 2
+    MASTER_DEPOSE = 3
     PROXY_COMMIT = 10
     PROXY_GET_READ_VERSION = 11
     PROXY_GET_KEY_LOCATIONS = 12
@@ -112,12 +114,14 @@ class ResolveTransactionBatchReply:
 
 @dataclass
 class TLogCommitRequest:
-    """TLogInterface.h TLogCommitRequest: version-ordered mutation push."""
+    """TLogInterface.h TLogCommitRequest: version-ordered mutation push.
+    `epoch` routes to the right generation on a shared TLog host."""
 
     prev_version: int
     version: int
     messages: dict[int, list[Mutation]]  # tag -> mutations for that tag
     known_committed_version: int = 0
+    epoch: int = 0
 
 
 @dataclass
@@ -131,6 +135,7 @@ class TLogPeekRequest:
 
     tag: int
     begin: int
+    epoch: int = 0  # generation to peek on a shared TLog host
 
 
 @dataclass
@@ -150,6 +155,7 @@ class TLogPopRequest:
 
     tag: int
     version: int
+    epoch: int = 0  # generation to pop on a shared TLog host
 
 
 # --- storage ---
@@ -230,7 +236,8 @@ class WatchValueRequest:
 @dataclass
 class TLogLockRequest:
     """Epoch end (ILogSystem::epochEnd): stop accepting commits; report how
-    far this log got. masterserver recoverFrom locks the old generation."""
+    far this log got. masterserver recoverFrom locks the old generation.
+    `epoch` is the generation being LOCKED (routing on a shared host)."""
 
     epoch: int
 
@@ -244,11 +251,13 @@ class TLogLockReply:
 @dataclass
 class LogEpoch:
     """One generation of the log system (LogSystemConfig.h oldTLogs entry):
-    versions in [begin, end) are served by these TLogs (end None = current)."""
+    versions in (begin, end] are served by these TLogs (end None = current).
+    `epoch` is the generation number (routes requests on shared TLog hosts)."""
 
     begin: int
     end: int | None
     addrs: list[str]
+    epoch: int = 0
 
 
 @dataclass

@@ -7,29 +7,98 @@ version it assigned so downstream stages (resolvers, TLogs) can chain batches
 into a total order with no gaps. Retransmitted requests are deduped by
 (proxy_id, request_num) (:834-843).
 
-Recovery driving (masterCore :1160) arrives with the distribution milestone;
-this slice is the steady-state ACCEPTING_COMMITS behavior.
+Deposition: the reference's master dies when the coordinated state moves past
+its generation (its ReusableCoordinatedState writes start failing and the
+worker kills the role). Here the master holds an explicit lease against the
+coordinators: it peeks the cstate register (read-only, no ballot) and deposes
+itself if a newer epoch appears OR the coordinator quorum is unreachable for a
+lease period — so even a master partitioned away from the new cluster
+controller stops renewing its proxies' GRV leases within a bounded time
+(the fail-safe the recovery's grace period relies on).
 """
 
 from __future__ import annotations
 
-from foundationdb_tpu.core.sim import SimProcess
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
     GetCommitVersionReply, GetCommitVersionRequest, Token)
+from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 
 
 class Master:
-    def __init__(self, process: SimProcess, recovery_version: int = 0):
+    def __init__(self, process: SimProcess, recovery_version: int = 0,
+                 epoch: int = 0, coordinators: list[str] | None = None):
         self.process = process
         self.loop = process.net.loop
+        self.epoch = epoch
+        self.coordinators = list(coordinators or [])
+        self.deposed = False
         self.last_version_assigned = recovery_version
         self.last_version_time = self.loop.now()
         # (proxy_id -> (request_num, reply)) retransmit dedupe window
         self._last_reply: dict[int, tuple[int, GetCommitVersionReply]] = {}
         process.register(Token.MASTER_GET_COMMIT_VERSION, self._on_get_commit_version)
+        process.register(Token.MASTER_PING, self._on_ping)
+        process.register(Token.MASTER_DEPOSE, self._on_depose)
+        self._lease_task = None
+        if self.coordinators:
+            self._lease_task = process.spawn(self._cstate_lease_loop(),
+                                             "masterCstateLease")
+
+    def shutdown(self):
+        if self._lease_task is not None:
+            self._lease_task.cancel()
+
+    def _on_ping(self, req, reply):
+        """Proxy liveness lease: a proxy that cannot reach ITS (undeposed)
+        master stops serving read versions, so a deposed generation cannot
+        hand out stale snapshots after a recovery."""
+        if self.deposed:
+            reply.send_error(FDBError("master_recovery_failed", "deposed"))
+        else:
+            reply.send(self.epoch)
+
+    def _on_depose(self, req, reply):
+        """Fast-path fence from the recovering cluster controller; the cstate
+        lease below is the backstop when this message cannot be delivered."""
+        if req is None or req >= self.epoch:
+            self.deposed = True
+        reply.send(None)
+
+    async def _cstate_lease_loop(self):
+        from foundationdb_tpu.server.coordination import (
+            CoordToken, GenReadRequest)
+        lease = KNOBS.MASTER_CSTATE_LEASE_SECONDS
+        quorum = len(self.coordinators) // 2 + 1
+        last_confirm = self.loop.now()
+        while not self.deposed:
+            votes = 0
+            newer = False
+            for addr in self.coordinators:
+                try:
+                    r = await self.loop.timeout(self.process.net.request(
+                        self.process, Endpoint(addr, CoordToken.GENERATION_PEEK),
+                        GenReadRequest(key="cstate", gen=0)), lease / 3)
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                    continue
+                votes += 1
+                if r.value is not None and r.value.get("epoch", 0) > self.epoch:
+                    newer = True
+            if newer or (votes < quorum
+                         and self.loop.now() - last_confirm > lease):
+                self.deposed = True
+                return
+            if votes >= quorum:
+                last_confirm = self.loop.now()
+            await self.loop.delay(lease / 3)
 
     def _on_get_commit_version(self, req: GetCommitVersionRequest, reply):
+        if self.deposed:
+            reply.send_error(FDBError("master_recovery_failed", "deposed"))
+            return
         prev = self._last_reply.get(req.proxy_id)
         if prev is not None and prev[0] == req.request_num:
             reply.send(prev[1])  # retransmit: same version again

@@ -100,11 +100,12 @@ class Proxy:
     def __init__(self, process: SimProcess, proxy_id: int, master: Endpoint,
                  resolvers: ResolverMap, tlogs: list[Endpoint],
                  shards: ShardMap, recovery_version: int = 0,
-                 other_proxies: list[str] | None = None):
+                 other_proxies: list[str] | None = None, epoch: int = 0):
         self.process = process
         self.loop = process.net.loop
         self.proxy_id = proxy_id
         self.master = master
+        self.epoch = epoch
         self.resolvers = resolvers
         self.tlogs = tlogs
         self.shards = shards
@@ -117,11 +118,43 @@ class Proxy:
         self.committed_version = NotifiedVersion(recovery_version)
         self._pending: list[tuple[CommitTransactionRequest, object]] = []
         self._batcher_armed = False
+        self._master_last_seen = self.loop.now()
         self.stats = {"commits_in": 0, "committed": 0, "conflicts": 0, "too_old": 0}
         process.register(Token.PROXY_COMMIT, self._on_commit)
         process.register(Token.PROXY_GET_READ_VERSION, self._on_grv)
         process.register(Token.PROXY_GET_COMMITTED_VERSION,
                          self._on_get_committed_version)
+        self._lease_task = process.spawn(self._master_lease_loop(), "masterLease")
+
+    def shutdown(self):
+        """Displaced by a newer generation on the same worker."""
+        self._lease_task.cancel()
+        self._master_last_seen = float("-inf")  # fence immediately
+
+    # -- master liveness lease --
+    # A proxy whose master is unreachable (dead, or replaced by a recovery)
+    # must stop serving read versions: a deposed generation handing out its
+    # stale committedVersion would let clients read snapshots that miss the
+    # new generation's commits. The reference gets this from the proxy's
+    # failure-monitored registration with the master; here it is an explicit
+    # ping lease.
+
+    def _master_live(self) -> bool:
+        return (self.loop.now() - self._master_last_seen
+                < KNOBS.PROXY_MASTER_LEASE_SECONDS)
+
+    async def _master_lease_loop(self):
+        ping = Endpoint(self.master.address, Token.MASTER_PING)
+        while True:
+            try:
+                epoch = await self.loop.timeout(
+                    self.process.net.request(self.process, ping, None), 1.0)
+                if epoch == self.epoch:
+                    self._master_last_seen = self.loop.now()
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+            await self.loop.delay(KNOBS.PROXY_MASTER_LEASE_SECONDS / 4)
 
     # -- GRV service --
 
@@ -129,6 +162,10 @@ class Proxy:
         reply.send(self.committed_version.get())
 
     def _on_grv(self, req: GetReadVersionRequest, reply):
+        if not self._master_live():
+            reply.send_error(FDBError("cluster_not_fully_recovered",
+                                      "proxy lost its master"))
+            return
         if not self.other_proxies:
             reply.send(GetReadVersionReply(version=self.committed_version.get()))
             return
@@ -149,6 +186,10 @@ class Proxy:
     # -- commit batching (queueTransactionStartRequests/batcher pattern) --
 
     def _on_commit(self, req: CommitTransactionRequest, reply):
+        if not self._master_live():
+            reply.send_error(FDBError("cluster_not_fully_recovered",
+                                      "proxy lost its master"))
+            return
         self.stats["commits_in"] += 1
         self._pending.append((req, reply))
         if len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX:
@@ -244,7 +285,8 @@ class Proxy:
                     TLogCommitRequest(
                         prev_version=prev_version, version=commit_version,
                         messages=messages,
-                        known_committed_version=self.committed_version.get()))
+                        known_committed_version=self.committed_version.get(),
+                        epoch=self.epoch))
                 for tl in self.tlogs]
             await self._wait_quorum(log_futures, quorum)
             self.latest_logging.set(batch_n)

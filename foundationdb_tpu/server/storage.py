@@ -35,7 +35,8 @@ class StorageServer:
     def __init__(self, process: SimProcess, tag: int,
                  tlog_addrs: list[str] | None = None,
                  recovery_version: int = 0,
-                 log_epochs: list[LogEpoch] | None = None):
+                 log_epochs: list[LogEpoch] | None = None,
+                 recovery_count: int = 0):
         """Pulls its tag from the log system's epoch list (version-routed:
         epoch (begin, end] served by that generation's TLogs); pops go to
         every TLog of every epoch holding the tag.
@@ -51,7 +52,7 @@ class StorageServer:
         if log_epochs is None:
             log_epochs = [LogEpoch(begin=0, end=None, addrs=list(tlog_addrs or []))]
         self.log_epochs: list[LogEpoch] = log_epochs
-        self.recovery_count = 0
+        self.recovery_count = recovery_count
         self._peek_rotation = 0  # failover index within an epoch's addrs
         self.store = MemoryKeyValueStore(
             process.net.open_file(process, f"storage-{tag}.0"),
@@ -77,6 +78,10 @@ class StorageServer:
         process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
         process.register(Token.STORAGE_SET_LOGSYSTEM, self._on_set_logsystem)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
+
+    def shutdown(self):
+        """Displaced by a re-created storage role on the same worker."""
+        self._pull_task.cancel()
 
     # -- recovery (rollback :2211 + log-system rebind) --
 
@@ -121,7 +126,8 @@ class StorageServer:
                 # must also trigger replica failover, not hang ingestion
                 reply = await loop.timeout(self.process.net.request(
                     self.process, Endpoint(addr, Token.TLOG_PEEK),
-                    TLogPeekRequest(tag=self.tag, begin=self._peek_begin + 1)),
+                    TLogPeekRequest(tag=self.tag, begin=self._peek_begin + 1,
+                                    epoch=epoch.epoch)),
                     2.0)
             except FDBError as e:
                 if e.name == "operation_cancelled":
@@ -179,15 +185,16 @@ class StorageServer:
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
         self.store.commit()
         self.data.forget_before(target)
-        popped: set[str] = set()
+        popped: set[tuple[str, int]] = set()
         for epoch in self.log_epochs:
             for addr in epoch.addrs:
-                if addr in popped:
+                if (addr, epoch.epoch) in popped:
                     continue
-                popped.add(addr)
+                popped.add((addr, epoch.epoch))
                 self.process.net.one_way(
                     self.process, Endpoint(addr, Token.TLOG_POP),
-                    TLogPopRequest(tag=self.tag, version=target))
+                    TLogPopRequest(tag=self.tag, version=target,
+                                   epoch=epoch.epoch))
         # prune fully-drained generations (the reference discards a log
         # generation once every tag is popped past its end) — bounds the pop
         # fan-out as recoveries accumulate; pruned after this round's pop so

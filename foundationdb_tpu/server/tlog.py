@@ -29,7 +29,7 @@ from foundationdb_tpu.utils.errors import FDBError
 
 class TLog:
     def __init__(self, process: SimProcess, recovery_version: int = 0,
-                 file_name: str = "tlog.dq"):
+                 file_name: str = "tlog.dq", register: bool = True):
         self.process = process
         self.version = NotifiedVersion(recovery_version)  # durable version
         self.messages: dict[int, deque] = {}  # tag -> deque[(version, [Mutation])]
@@ -39,10 +39,11 @@ class TLog:
         self.queue = DiskQueue(process.net.open_file(process, file_name + ".0"),
                                process.net.open_file(process, file_name + ".1"))
         self._version_seq: deque[tuple[int, int]] = deque()  # (version, seq)
-        process.register(Token.TLOG_COMMIT, self._on_commit)
-        process.register(Token.TLOG_PEEK, self._on_peek)
-        process.register(Token.TLOG_POP, self._on_pop)
-        process.register(Token.TLOG_LOCK, self._on_lock)
+        if register:
+            process.register(Token.TLOG_COMMIT, self._on_commit)
+            process.register(Token.TLOG_PEEK, self._on_peek)
+            process.register(Token.TLOG_POP, self._on_pop)
+            process.register(Token.TLOG_LOCK, self._on_lock)
 
     def _on_lock(self, req: TLogLockRequest, reply):
         """Epoch end: fence old-generation commits (TLogServer lock path /
@@ -137,3 +138,39 @@ class TLog:
         if last > self.version.get():
             self.version.set(last)
         return last
+
+
+class TLogHost:
+    """All TLog generations hosted by one process, routed by epoch.
+
+    Reference: TLogServer.actor.cpp's shared TLog (tLogFn) — after a
+    recovery, the OLD locked generation keeps serving peeks (storage servers
+    drain it) while the NEW generation accepts commits, both in the same
+    process. Without this, recruiting a new generation onto a worker would
+    replace the old generation's endpoints and strand its undrained data.
+    """
+
+    def __init__(self, process: SimProcess):
+        self.process = process
+        self.generations: dict[int, TLog] = {}
+        process.register(Token.TLOG_COMMIT, self._route(TLog._on_commit))
+        process.register(Token.TLOG_PEEK, self._route(TLog._on_peek))
+        process.register(Token.TLOG_POP, self._route(TLog._on_pop))
+        process.register(Token.TLOG_LOCK, self._route(TLog._on_lock))
+
+    def add(self, epoch: int, recovery_version: int = 0,
+            file_name: str = "tlog.dq") -> TLog:
+        t = TLog(self.process, recovery_version=recovery_version,
+                 file_name=file_name, register=False)
+        self.generations[epoch] = t
+        return t
+
+    def _route(self, method):
+        def handler(req, reply):
+            t = self.generations.get(req.epoch)
+            if t is None:
+                reply.send_error(FDBError("tlog_stopped",
+                                          f"no generation {req.epoch}"))
+            else:
+                method(t, req, reply)
+        return handler

@@ -1,0 +1,124 @@
+"""Worker: the role host every server process runs.
+
+Reference: fdbserver/worker.actor.cpp (workerServer :498) — a worker registers
+with the cluster controller, serves Initialize*Request RPCs by instantiating
+roles in-process (:694-794), and on reboot restores disk-backed roles (the
+storage server re-attaches to its files). Here the Initialize* family is
+collapsed into one parameterized InitRoleRequest (interfaces.py).
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.server.coordination import CoordToken, get_leader
+from foundationdb_tpu.server.interfaces import (
+    InitRoleReply, InitRoleRequest, RegisterWorkerRequest, Token)
+from foundationdb_tpu.utils.errors import FDBError
+
+
+class Worker:
+    def __init__(self, process: SimProcess, coordinators: list[str],
+                 capabilities: list[str]):
+        self.process = process
+        self.coordinators = coordinators
+        self.capabilities = capabilities
+        self.roles: dict[str, object] = {}  # "proxy:3" -> role object
+        process.register(Token.WORKER_PING, self._on_ping)
+        process.register(Token.WORKER_INIT_ROLE, self._on_init_role)
+        process.spawn(self._register_loop(), "workerRegister")
+        # a rebooted process with storage files re-attaches the storage role
+        # once the cluster controller can tell it the current log system
+        # (worker.actor.cpp: storage servers restore from disk at startup)
+        if any(name.startswith("storage-") for name in process.files):
+            process.spawn(self._restore_storage(), "restoreStorage")
+
+    # -- liveness (waitFailureServer analogue) --
+
+    def _on_ping(self, req, reply):
+        reply.send(self.process.address)
+
+    async def _register_loop(self):
+        """Advertise to the current cluster controller (workerServer's
+        registrationClient): repeats so a new CC learns every worker."""
+        net = self.process.net
+        while True:
+            try:
+                leader = await get_leader(self.process, self.coordinators)
+                if leader:
+                    net.one_way(self.process,
+                                Endpoint(leader, Token.CC_REGISTER_WORKER),
+                                RegisterWorkerRequest(
+                                    address=self.process.address,
+                                    roles=list(self.capabilities)))
+            except FDBError:
+                pass
+            await net.loop.delay(1.0)
+
+    # -- recruitment (InitializeTLogRequest etc., worker.actor.cpp:694-794) --
+
+    def _on_init_role(self, req: InitRoleRequest, reply):
+        try:
+            self._make_role(req.role, req.args)
+            reply.send(InitRoleReply(address=self.process.address))
+        except Exception as e:  # noqa: BLE001 — recruiter sees the failure
+            reply.send_error(FDBError("recruitment_failed", repr(e)))
+
+    def _set_role(self, key: str, role):
+        """A re-recruited role displaces its predecessor: shut the old one
+        down so its background actors (lease pings etc.) don't leak."""
+        old = self.roles.get(key)
+        if old is not None and hasattr(old, "shutdown"):
+            old.shutdown()
+        self.roles[key] = role
+
+    def _make_role(self, role: str, args: dict):
+        if role == "master":
+            from foundationdb_tpu.server.master import Master
+            self._set_role("master", Master(self.process, **args))
+        elif role == "proxy":
+            from foundationdb_tpu.server.proxy import Proxy
+            self._set_role(f"proxy:{args['proxy_id']}",
+                           Proxy(self.process, **args))
+        elif role == "resolver":
+            from foundationdb_tpu.server.resolver import Resolver
+            self._set_role("resolver", Resolver(self.process, **args))
+        elif role == "tlog":
+            from foundationdb_tpu.server.tlog import TLogHost
+            host = self.roles.get("tloghost")
+            if host is None:
+                host = self.roles["tloghost"] = TLogHost(self.process)
+            host.add(**args)
+        elif role == "storage":
+            from foundationdb_tpu.server.storage import StorageServer
+            self._set_role(f"storage:{args['tag']}",
+                           StorageServer(self.process, **args))
+        else:
+            raise ValueError(f"unknown role {role!r}")
+
+    async def _restore_storage(self):
+        """Re-create the storage role from durable files after a reboot,
+        binding it to the current log system from the CC's DBInfo."""
+        net = self.process.net
+        tags = sorted({int(name.split("-")[1].split(".")[0])
+                       for name in self.process.files
+                       if name.startswith("storage-")})
+        while True:
+            try:
+                leader = await get_leader(self.process, self.coordinators)
+                if leader:
+                    info = await net.loop.timeout(net.request(
+                        self.process, Endpoint(leader, Token.CC_GET_DBINFO),
+                        None), 2.0)
+                    if info.recovery_state == "accepting_commits":
+                        from foundationdb_tpu.server.storage import StorageServer
+                        for tag in tags:
+                            key = f"storage:{tag}"
+                            if key not in self.roles:
+                                self.roles[key] = StorageServer(
+                                    self.process, tag=tag,
+                                    log_epochs=list(info.log_epochs),
+                                    recovery_count=info.epoch)
+                        return
+            except FDBError:
+                pass
+            await net.loop.delay(0.5)
