@@ -1,9 +1,10 @@
 """Database: client handle bound to a cluster (proxies + storage endpoints).
 
 Reference: fdbclient/NativeAPI.actor.cpp Database/DatabaseContext — owns the
-shard-location cache, the read-version batcher (:2709), and the retry-loop
-helper every binding exposes as `@fdb.transactional` (the RYW commit/onError
-loop, bindings/python/fdb/impl.py).
+shard-location cache (getKeyLocation :1040 / getKeyRangeLocations :1083 with
+wrong_shard_server invalidation), the read-version batcher (:2709), and the
+retry-loop helper every binding exposes as `@fdb.transactional` (the RYW
+commit/onError loop, bindings/python/fdb/impl.py).
 
 The GRV batcher coalesces concurrent read-version requests into one proxy
 round-trip per GRV_BATCH_INTERVAL, like readVersionBatcher.
@@ -15,11 +16,51 @@ from foundationdb_tpu.client.transaction import Transaction
 from foundationdb_tpu.core.future import Future
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
-    GetKeyValuesRequest, GetReadVersionRequest, GetValueRequest, Token,
-    WatchValueRequest)
+    GetKeyValuesReply, GetKeyValuesRequest, GetReadVersionRequest,
+    GetValueRequest, KeySelector, Token, WatchValueRequest)
+from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+class LocationCache:
+    """Client-side shard map: sorted begin-boundaries -> storage address.
+
+    The cache is a HINT (NativeAPI keyServersInfo cache): a stale entry makes
+    a storage server answer wrong_shard_server, which invalidates the cache;
+    the next access re-resolves through the cluster (refresh)."""
+
+    def __init__(self, boundaries: list[bytes] | None = None,
+                 addrs: list[str] | None = None):
+        self.boundaries = list(boundaries or [])
+        self.addrs = list(addrs or [])
+
+    @property
+    def valid(self) -> bool:
+        return bool(self.boundaries)
+
+    def update(self, boundaries: list[bytes], addrs: list[str]):
+        self.boundaries = list(boundaries)
+        self.addrs = list(addrs)
+
+    def invalidate(self):
+        self.boundaries = []
+        self.addrs = []
+
+    def locate(self, key: bytes) -> tuple[str, bytes | None]:
+        """(owner address, end of the containing shard; None = +inf)."""
+        i = keylib.partition_index(self.boundaries, key)
+        end = self.boundaries[i + 1] if i + 1 < len(self.boundaries) else None
+        return self.addrs[i], end
+
+    def locate_before(self, end: bytes) -> tuple[str, bytes]:
+        """Shard containing keys strictly below `end` (reverse iteration):
+        (owner address, begin of that shard)."""
+        i = keylib.partition_index(self.boundaries, end)
+        if self.boundaries[i] == end and i > 0:
+            i -= 1
+        return self.addrs[i], self.boundaries[i]
 
 
 # Errors that mean "the cluster moved under us": refresh the cluster layout
@@ -33,10 +74,11 @@ _CLUSTER_ERRORS = frozenset({
 
 class Database:
     def __init__(self, process: SimProcess, proxies: list[str] | None = None,
-                 storage_for_key=None, rng: DeterministicRandom | None = None,
+                 locations: LocationCache | None = None,
+                 rng: DeterministicRandom | None = None,
                  coordinators: list[str] | None = None):
-        """`storage_for_key(key) -> address` is the location cache stand-in;
-        with data distribution it becomes a real cached shard map.
+        """`locations` is the shard-location cache; statically-built clusters
+        seed it directly, coordinator-discovered ones fill it via refresh().
 
         With `coordinators`, the client discovers (and re-discovers, after
         recoveries) the proxy list and storage layout through the elected
@@ -45,7 +87,7 @@ class Database:
         self.process = process
         self.loop = process.net.loop
         self.proxies = list(proxies or [])  # proxy process addresses
-        self.storage_for_key = storage_for_key
+        self.locations = locations or LocationCache()
         self.coordinators = list(coordinators or [])
         self._rng = rng or DeterministicRandom(0xDB)
         self._grv_waiters: list[Future] = []
@@ -73,6 +115,11 @@ class Database:
                             raise
                         # no recovered cluster yet: burn one retry and keep
                         # trying — a slow recovery is a retryable condition
+                    # back off: right after a role dies the CC's DBInfo can
+                    # still list it for a failure-detection interval, so a
+                    # free refresh + instant retry would spin through the
+                    # whole retry budget inside that window
+                    await self.loop.delay(0.1 * (0.5 + self._rng.random()))
                     tr = self.create_transaction()
                     continue
                 await tr.on_error(e)  # re-raises when not retryable
@@ -85,7 +132,6 @@ class Database:
         from foundationdb_tpu.core.sim import Endpoint
         from foundationdb_tpu.server.coordination import get_leader
         from foundationdb_tpu.server.interfaces import Token
-        from foundationdb_tpu.utils.keys import partition_index
 
         deadline = self.loop.now() + max_wait
         while self.loop.now() < deadline:
@@ -99,11 +145,9 @@ class Database:
                         self.proxies = list(info.proxies)
                         addr_of_tag = {tag: addr for addr, tag in info.storages}
                         boundaries = list(info.shard_boundaries)
-
-                        def storage_for_key(key: bytes) -> str:
-                            return addr_of_tag[partition_index(boundaries, key)]
-
-                        self.storage_for_key = storage_for_key
+                        self.locations.update(
+                            boundaries,
+                            [addr_of_tag[i] for i in range(len(boundaries))])
                         return
             except FDBError as e:
                 if e.name == "operation_cancelled":
@@ -144,24 +188,121 @@ class Database:
                 if not w.is_ready():
                     w._set_error(FDBError(e.name, e.detail))
 
-    def _storage_addr(self, key: bytes) -> str:
-        if self.storage_for_key is None:
-            raise FDBError("cluster_not_fully_recovered", "no layout known")
-        return self.storage_for_key(key)
+    async def _ensure_locations(self):
+        if not self.locations.valid:
+            if not self.coordinators:
+                raise FDBError("cluster_not_fully_recovered", "no layout known")
+            await self.refresh()
+
+    async def _storage_request(self, key: bytes, token: int, req,
+                               max_attempts: int = 5):
+        """Locate `key`'s shard and send; wrong_shard_server (stale cache
+        after a shard move) or a dead owner invalidates and re-resolves
+        (NativeAPI:1177 getValue's wrong_shard_server retry)."""
+        for _ in range(max_attempts):
+            await self._ensure_locations()
+            addr, _end = self.locations.locate(key)
+            try:
+                return await self.process.net.request(
+                    self.process, Endpoint(addr, token), req)
+            except FDBError as e:
+                if e.name == "wrong_shard_server" and self.coordinators:
+                    self.locations.invalidate()
+                    continue
+                raise
+        raise FDBError("wrong_shard_server", "location cache cannot converge")
 
     def _get_value(self, req: GetValueRequest) -> Future:
-        ep = Endpoint(self._storage_addr(req.key), Token.STORAGE_GET_VALUE)
-        return self.process.net.request(self.process, ep, req)
+        return self.loop.spawn(self._storage_request(
+            req.key, Token.STORAGE_GET_VALUE, req), "getValue")
 
     def _get_range(self, req: GetKeyValuesRequest) -> Future:
-        # single-shard for now: the begin selector's owner serves the range
-        ep = Endpoint(self._storage_addr(req.begin.key),
-                      Token.STORAGE_GET_KEY_VALUES)
-        return self.process.net.request(self.process, ep, req)
+        return self.loop.spawn(self._get_range_shards(req), "getRangeShards")
+
+    async def _get_range_shards(self, req: GetKeyValuesRequest):
+        """Cross-shard range read: iterate the shards covering [begin, end)
+        (in reverse order for reverse reads), clamping each sub-request to
+        its shard, and combine — the reference's getKeyRangeLocations
+        (:1083) fan-out with per-shard continuations. The caller's
+        continuation loop handles `more` exactly as for one shard."""
+        begin, end = req.begin.key, req.end.key
+        rows: list[tuple[bytes, bytes]] = []
+        remaining = req.limit
+
+        async def fetch(addr, lo, hi):
+            sub = GetKeyValuesRequest(
+                begin=KeySelector.first_greater_or_equal(lo),
+                end=KeySelector.first_greater_or_equal(hi),
+                version=req.version, limit=remaining,
+                limit_bytes=req.limit_bytes, reverse=req.reverse)
+            return await self.process.net.request(
+                self.process, Endpoint(addr, Token.STORAGE_GET_KEY_VALUES), sub)
+
+        attempts = 0
+        if not req.reverse:
+            cur = begin
+            while cur < end:
+                await self._ensure_locations()
+                addr, shard_end = self.locations.locate(cur)
+                hi = end if shard_end is None else min(end, shard_end)
+                try:
+                    reply = await fetch(addr, cur, hi)
+                except FDBError as e:
+                    if e.name == "wrong_shard_server" and self.coordinators \
+                            and attempts < 5:
+                        attempts += 1
+                        self.locations.invalidate()
+                        continue
+                    raise
+                rows.extend(reply.data)
+                if reply.more:
+                    return GetKeyValuesReply(data=rows, more=True,
+                                             version=req.version)
+                if req.limit:
+                    remaining = req.limit - len(rows)
+                    if remaining <= 0:
+                        more = hi < end
+                        return GetKeyValuesReply(data=rows, more=more,
+                                                 version=req.version)
+                cur = hi
+            return GetKeyValuesReply(data=rows, more=False, version=req.version)
+
+        cur = end
+        while begin < cur:
+            await self._ensure_locations()
+            addr, shard_begin = self.locations.locate_before(cur)
+            lo = max(begin, shard_begin)
+            try:
+                reply = await fetch(addr, lo, cur)
+            except FDBError as e:
+                if e.name == "wrong_shard_server" and self.coordinators \
+                        and attempts < 5:
+                    attempts += 1
+                    self.locations.invalidate()
+                    continue
+                raise
+            rows.extend(reply.data)
+            if reply.more:
+                return GetKeyValuesReply(data=rows, more=True,
+                                         version=req.version)
+            if req.limit:
+                remaining = req.limit - len(rows)
+                if remaining <= 0:
+                    return GetKeyValuesReply(data=rows, more=begin < lo,
+                                             version=req.version)
+            cur = lo
+        return GetKeyValuesReply(data=rows, more=False, version=req.version)
 
     def _watch(self, req: WatchValueRequest) -> Future:
-        ep = Endpoint(self._storage_addr(req.key), Token.STORAGE_WATCH_VALUE)
-        return self.process.net.request(self.process, ep, req)
+        async def watch():
+            await self._ensure_locations()
+            addr, _end = self.locations.locate(req.key)
+            # watches are deliberately unbounded waits (watchValueQ blocks
+            # until the value changes): exempt from the default RPC timeout
+            return await self.process.net.request(
+                self.process, Endpoint(addr, Token.STORAGE_WATCH_VALUE), req,
+                timeout=None)
+        return self.loop.spawn(watch(), "watch")
 
     def _commit(self, req) -> Future:
         return self.process.net.request(

@@ -205,30 +205,51 @@ class SimNetwork:
         return process.files[name]
 
     # -- transport --
-    def _link_ok(self, src: str, dst: str) -> bool:
+    def _link_delay(self, src: str, dst: str) -> float | None:
+        """None = dropped (partition); otherwise extra delivery delay.
+
+        Clogging DELAYS packets instead of dropping them (Sim2Conn clogs the
+        connection; TCP retransmits underneath, sim2.actor.cpp:133-179) — a
+        clogged-then-healed link delivers everything late, which is what lets
+        version-chained pipelines (resolver prevVersion order, TLog version
+        order) drain instead of wedging on a gap. Partitions drop."""
         if (src, dst) in self._partitioned:
-            return False
+            return None
         until = self._clogged_until.get((src, dst))
         if until is not None and until > self.loop.now():
-            return False
-        return True
+            return until - self.loop.now()
+        return 0.0
 
     def _latency(self) -> float:
         lo, hi = KNOBS.SIM_MIN_LATENCY, KNOBS.SIM_MAX_LATENCY
         return lo + (hi - lo) * self.rng.random()
 
     def request(self, src: SimProcess, dest: Endpoint, payload: Any,
-                priority: int = TaskPriority.DefaultOnMainThread) -> Future:
+                priority: int = TaskPriority.DefaultOnMainThread,
+                timeout: float | None = -1.0) -> Future:
         """RequestStream::getReply — send `payload`, future of the reply.
 
         The reply promise traverses the network (fdbrpc/fdbrpc.h:99): the
         callee's handler fulfills it; if the callee is dead at delivery time or
         dies before replying, the caller sees broken_promise.
-        """
+
+        A clogged/partitioned link DROPS the packet; without a bound every
+        such await would hang its actor forever, so requests carry a default
+        timeout (SIM_RPC_TIMEOUT_SECONDS) after which the caller sees
+        request_maybe_delivered — the reference surfaces the same through
+        connection failure + IFailureMonitor. Pass timeout=None for
+        deliberately unbounded waits (watches)."""
         reply = Promise()
         if not src.alive:
             reply.send_error(FDBError("operation_cancelled"))
             return reply.future
+        if timeout == -1.0:
+            timeout = KNOBS.SIM_RPC_TIMEOUT_SECONDS
+        if timeout is not None:
+            self.loop._schedule(
+                timeout, TaskPriority.DefaultDelay,
+                lambda: reply.send_error(FDBError("request_maybe_delivered"))
+                if not reply.is_set() else None)
 
         def deliver():
             dst = self.processes.get(dest.address)
@@ -253,9 +274,11 @@ class SimNetwork:
             inner.future.add_callback(on_reply)
             dst.handlers[dest.token](payload, inner)
 
-        if self._link_ok(src.address, dest.address):
-            self.loop._schedule(self._latency(), priority, deliver)
-        # else: packet dropped; caller's timeout/failure-monitor handles it
+        extra = self._link_delay(src.address, dest.address)
+        if extra is not None:
+            self.loop._schedule(extra + self._latency(), priority, deliver)
+        # else: partitioned; packet dropped — the caller's timeout or the
+        # failure monitor surfaces it
         return reply.future
 
     def _send_back(self, reply: Promise, result: Any, is_error: bool):
@@ -279,5 +302,8 @@ class SimNetwork:
                 return
             dst.handlers[dest.token](payload, Promise())
 
-        if src.alive and self._link_ok(src.address, dest.address):
-            self.loop._schedule(self._latency(), TaskPriority.DefaultOnMainThread, deliver)
+        if src.alive:
+            extra = self._link_delay(src.address, dest.address)
+            if extra is not None:
+                self.loop._schedule(extra + self._latency(),
+                                    TaskPriority.DefaultOnMainThread, deliver)

@@ -24,11 +24,7 @@ from foundationdb_tpu.server.tlog import TLog
 from foundationdb_tpu.utils.rng import DeterministicRandom
 
 
-def _partition_boundaries(n: int) -> list[bytes]:
-    """n contiguous key-space partitions: [b""] + n-1 single-byte cuts."""
-    if n <= 1:
-        return [b""]
-    return [b""] + [bytes([int(256 * i / n)]) for i in range(1, n)]
+from foundationdb_tpu.utils.keys import partition_boundaries as _partition_boundaries
 
 
 class SimCluster:
@@ -66,10 +62,15 @@ class SimCluster:
             boundaries=_partition_boundaries(n_resolvers),
             endpoints=resolver_eps)
 
+        def shard_range(i):
+            b = self.shard_boundaries
+            return [(b[i], b[i + 1] if i + 1 < len(b) else None)]
+
         tlog_addrs = [p.address for p in self.tlog_procs]
         self.storages = [
             StorageServer(p, tag=i,
-                          tlog_addrs=tlog_addrs[i % n_tlogs:] + tlog_addrs[:i % n_tlogs])
+                          tlog_addrs=tlog_addrs[i % n_tlogs:] + tlog_addrs[:i % n_tlogs],
+                          shard_ranges=shard_range(i))
             for i, p in enumerate(self.storage_procs)]
 
         # reboot wiring: a rebooted process re-runs its role on surviving
@@ -77,7 +78,8 @@ class SimCluster:
         for i, proc in enumerate(self.storage_procs):
             def boot_storage(p, i=i, n=n_tlogs):
                 addrs = tlog_addrs[i % n:] + tlog_addrs[:i % n]
-                self.storages[i] = StorageServer(p, tag=i, tlog_addrs=addrs)
+                self.storages[i] = StorageServer(p, tag=i, tlog_addrs=addrs,
+                                                 shard_ranges=shard_range(i))
             proc.boot_fn = boot_storage
         for i, proc in enumerate(self.tlog_procs):
             def boot_tlog(p, i=i):
@@ -95,14 +97,12 @@ class SimCluster:
     # -- client handles --
 
     def database(self, name: str = "client:0") -> Database:
+        from foundationdb_tpu.client.database import LocationCache
         proc = self.net.processes.get(name) or self.net.new_process(name)
-        boundaries = self.shard_boundaries
-
-        def storage_for_key(key: bytes) -> str:
-            from foundationdb_tpu.utils.keys import partition_index
-            return self.storage_procs[partition_index(boundaries, key)].address
-
-        return Database(proc, self.proxy_addrs, storage_for_key, rng=self.rng.fork())
+        cache = LocationCache(self.shard_boundaries,
+                              [p.address for p in self.storage_procs])
+        return Database(proc, self.proxy_addrs, locations=cache,
+                        rng=self.rng.fork())
 
     # -- driving --
 

@@ -33,6 +33,7 @@ from foundationdb_tpu.server.interfaces import (
     DBInfo, InitRoleRequest, LogEpoch, RegisterWorkerRequest,
     SetLogSystemRequest, TLogLockRequest, Token)
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.keys import partition_boundaries as _partition_boundaries
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.trace import TraceEvent
 
@@ -43,12 +44,6 @@ class ClusterConfig:
     n_resolvers: int = 1
     n_tlogs: int = 1
     n_storage: int = 1
-
-
-def _partition_boundaries(n: int) -> list[bytes]:
-    if n <= 1:
-        return [b""]
-    return [b""] + [bytes([int(256 * i / n)]) for i in range(1, n)]
 
 
 @dataclass
@@ -81,6 +76,8 @@ class ClusterController:
         self.deposed = False
         self._need_recovery = Future()
         self._watchers: list = []
+        self._incarnations: dict[str, int] = {}
+        self._attempt = 0
         process.register(Token.CC_REGISTER_WORKER, self._on_register)
         process.register(Token.CC_GET_DBINFO, self._on_get_dbinfo)
 
@@ -117,24 +114,55 @@ class ClusterController:
 
     # -- role failure detection (waitFailureClient analogue) --
 
-    async def _watch_role(self, address: str, what: str):
+    async def _watch_role(self, address: str, what: str, incarnation: int):
+        """A role is dead when its worker stops answering OR answers with a
+        newer incarnation (the worker rebooted: the process is back but the
+        roles recruited on it died with the old incarnation)."""
         misses = 0
         while True:
             try:
-                await self.loop.timeout(self.net.request(
+                inc = await self.loop.timeout(self.net.request(
                     self.process, Endpoint(address, Token.WORKER_PING), None),
                     1.0)
-                misses = 0
+                if inc != incarnation:
+                    misses = 2
+                else:
+                    misses = 0
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise
                 misses += 1
-                if misses >= 2:
-                    TraceEvent("CCRoleFailed", self.process.address) \
-                        .detail("Role", what).detail("Address", address).log()
-                    if not self._need_recovery.is_ready():
-                        self._need_recovery._set(f"{what}@{address}")
-                    return
+            if misses >= 2:
+                TraceEvent("CCRoleFailed", self.process.address) \
+                    .detail("Role", what).detail("Address", address).log()
+                if not self._need_recovery.is_ready():
+                    self._need_recovery._set(f"{what}@{address}")
+                return
+            await self.loop.delay(0.5)
+
+    async def _watch_epoch_role(self, address: str, token: int, epoch: int,
+                                what: str):
+        """Worker pings can't see a ROLE stomped by a competing recovery
+        attempt on the same worker (the process never rebooted), a master
+        that self-deposed, or a proxy that died because its commit pipeline
+        kept failing — watch the role's own epoch-answering endpoint."""
+        misses = 0
+        while True:
+            try:
+                got = await self.loop.timeout(self.net.request(
+                    self.process, Endpoint(address, token), None), 1.0)
+                misses = 0 if got == epoch else 2
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                misses += 1
+            if misses >= 2:
+                TraceEvent("CCEpochRoleFailed", self.process.address) \
+                    .detail("What", what).detail("Address", address) \
+                    .detail("Epoch", epoch).log()
+                if not self._need_recovery.is_ready():
+                    self._need_recovery._set(f"{what}@{address}")
+                return
             await self.loop.delay(0.5)
 
     # -- the recovery state machine --
@@ -171,6 +199,7 @@ class ClusterController:
         for w in self._watchers:
             w.cancel()
         self._watchers = []
+        self._incarnations: dict[str, int] = {}
         # ---- READING_CSTATE ----
         self.dbinfo.recovery_state = "reading_cstate"
         prior, _gen = await self.cstate.read()
@@ -193,7 +222,8 @@ class ClusterController:
             old_epochs[-1] = LogEpoch(begin=old_epochs[-1].begin,
                                       end=recovery_version,
                                       addrs=old_epochs[-1].addrs,
-                                      epoch=old_epochs[-1].epoch)
+                                      epoch=old_epochs[-1].epoch,
+                                      uids=old_epochs[-1].uids)
 
         # the new generation starts above anything any process can have seen
         # in flight (masterserver.actor.cpp:858 bump)
@@ -204,17 +234,25 @@ class ClusterController:
         now = self.loop.now()
         stateless = self.registry.alive("stateless", now)
         log_workers = self.registry.alive("tlog", now)
-        if not stateless or len(log_workers) < cfg.n_tlogs:
+        # one resolver/proxy per worker: co-locating two same-keyed roles on
+        # one process would silently displace the first (single endpoint
+        # token per role kind per process)
+        if (len(stateless) < max(1, cfg.n_proxies, cfg.n_resolvers)
+                or len(log_workers) < cfg.n_tlogs):
             raise FDBError("recruitment_failed", "not enough workers")
 
-        # new TLog generation: fresh instances with epoch-suffixed files so an
-        # old locked generation's disk state is never reused
+        # new TLog generation: fresh instances with UNIQUE ids (and uid-named
+        # files), so neither an old locked generation nor a racing recovery
+        # attempt can ever be stomped on a shared host
+        self._attempt += 1
+        uids = [f"e{epoch}-{self.process.address}-a{self._attempt}-t{i}"
+                for i in range(cfg.n_tlogs)]
         tlog_addrs = await self._recruit_many(
             log_workers, cfg.n_tlogs, "tlog",
-            lambda i: {"epoch": epoch, "recovery_version": start_version,
-                       "file_name": f"tlog-e{epoch}.dq"})
+            lambda i: {"uid": uids[i], "recovery_version": start_version})
         new_epochs = old_epochs + [LogEpoch(begin=recovery_version, end=None,
-                                            addrs=tlog_addrs, epoch=epoch)]
+                                            addrs=tlog_addrs, epoch=epoch,
+                                            uids=uids)]
 
         resolver_addrs = await self._recruit_many(
             stateless, cfg.n_resolvers, "resolver",
@@ -230,11 +268,21 @@ class ClusterController:
                 raise FDBError("recruitment_failed", "not enough storage workers")
             storages = []
             for i in range(cfg.n_storage):
+                srange = (boundaries[i],
+                          boundaries[i + 1] if i + 1 < len(boundaries) else None)
                 addr = (await self._recruit_many(
                     [storage_workers[i % len(storage_workers)]], 1, "storage",
-                    lambda _i, i=i: {"tag": i, "log_epochs": list(new_epochs),
-                                     "recovery_count": epoch}))[0]
+                    lambda _i, i=i, srange=srange: {
+                        "tag": i, "log_epochs": list(new_epochs),
+                        "recovery_count": epoch, "shard_ranges": [srange]}))[0]
                 storages.append((addr, i))
+
+        # admission control alongside the new generation (Ratekeeper runs
+        # with the master in the reference)
+        rk_addr = (await self._recruit_many(
+            stateless, 1, "ratekeeper",
+            lambda i: {"tlogs": list(tlog_addrs),
+                       "storages": [a for a, _t in storages]}))[0]
 
         from foundationdb_tpu.server.proxy import ResolverMap, ShardMap
         shard_map = ShardMap(boundaries=boundaries,
@@ -255,11 +303,15 @@ class ClusterController:
                     "master": Endpoint(master_addr, Token.MASTER_GET_COMMIT_VERSION),
                     "resolvers": resolver_map,
                     "tlogs": [Endpoint(a, Token.TLOG_COMMIT) for a in tlog_addrs],
+                    "tlog_uids": list(uids),
                     "shards": shard_map,
                     "recovery_version": start_version,
                     "epoch": epoch,
                     "other_proxies": [a for a in proxy_addrs
                                       if a != proxy_addrs[i]],
+                    "ratekeeper": rk_addr,
+                    "n_proxies": cfg.n_proxies,
+                    "die_on_failure": True,
                 })
 
         # ---- WRITING_CSTATE: fencing point for competing recoveries ----
@@ -302,16 +354,28 @@ class ClusterController:
             version=self.dbinfo.version + 1, epoch=epoch, master=master_addr,
             proxies=proxy_addrs, resolvers=resolver_addrs,
             log_epochs=new_epochs, storages=storages,
-            shard_boundaries=boundaries, recovery_state="accepting_commits")
+            shard_boundaries=boundaries, recovery_state="accepting_commits",
+            ratekeeper=rk_addr)
         TraceEvent("CCRecovered", self.process.address) \
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()
 
-        # babysit the new generation
+        # babysit the new generation (role stomps by racing recoveries,
+        # self-deposed masters, and self-killed proxies are caught by the
+        # epoch watchers; worker deaths by the incarnation pings)
+        self._watchers.append(self.process.spawn(
+            self._watch_epoch_role(master_addr, Token.MASTER_PING, epoch,
+                                   "master"), "watchMaster"))
+        for pa in proxy_addrs:
+            self._watchers.append(self.process.spawn(
+                self._watch_epoch_role(pa, Token.PROXY_PING, epoch, "proxy"),
+                "watchProxy"))
         for addr in sorted(set([master_addr] + proxy_addrs + resolver_addrs
-                               + tlog_addrs)):
-            self._watchers.append(
-                self.process.spawn(self._watch_role(addr, "txn"), "watchRole"))
+                               + tlog_addrs + [rk_addr])):
+            self._watchers.append(self.process.spawn(
+                self._watch_role(addr, "txn",
+                                 self._incarnations.get(addr, 0)),
+                "watchRole"))
 
     async def _lock_old_generation(self, old: LogEpoch) -> int:
         """epochEnd (TagPartitionedLogSystem:398-417): lock enough old TLogs
@@ -331,7 +395,8 @@ class ClusterController:
         a = KNOBS.TLOG_QUORUM_ANTIQUORUM
         futures = [self.loop.timeout(self.net.request(
             self.process, Endpoint(addr, Token.TLOG_LOCK),
-            TLogLockRequest(epoch=old.epoch)), 2.0) for addr in old.addrs]
+            TLogLockRequest(epoch=old.epoch + 1, uid=old.uid_of(i))), 2.0)
+            for i, addr in enumerate(old.addrs)]
         # a+1 locked logs fence the old generation (the alive unlocked
         # remainder is below the N-a commit quorum) and suffice for safety:
         # any acked commit is durable on >= N-a logs, so >= s-a of any s
@@ -356,6 +421,10 @@ class ClusterController:
 
     async def _recruit_many(self, workers: list[str], n: int, role: str,
                             make_args) -> list[str]:
+        if self.deposed:
+            # a deposed CC must stop recruiting immediately: its half-built
+            # generation would stomp the new leader's roles on shared workers
+            raise FDBError("recruitment_failed", "deposed")
         addrs = []
         for i in range(n):
             addr = workers[i % len(workers)]
@@ -364,6 +433,7 @@ class ClusterController:
                     self.process, Endpoint(addr, Token.WORKER_INIT_ROLE),
                     InitRoleRequest(role=role, args=make_args(i))), 2.0)
                 addrs.append(r.address)
+                self._incarnations[r.address] = r.incarnation
             except FDBError as e:
                 raise FDBError("recruitment_failed",
                                f"{role} on {addr}: {e.name}") from None

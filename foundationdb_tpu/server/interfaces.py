@@ -27,6 +27,7 @@ class Token:
     PROXY_GET_READ_VERSION = 11
     PROXY_GET_KEY_LOCATIONS = 12
     PROXY_GET_COMMITTED_VERSION = 13
+    PROXY_PING = 14
     RESOLVER_RESOLVE = 20
     TLOG_COMMIT = 30
     TLOG_PEEK = 31
@@ -37,6 +38,8 @@ class Token:
     STORAGE_GET_SHARD_STATE = 43
     TLOG_LOCK = 33
     STORAGE_SET_LOGSYSTEM = 44
+    RK_GET_RATE = 80
+    QUEUE_STATS = 81
     WORKER_PING = 90
     WORKER_INIT_ROLE = 91
     CC_REGISTER_WORKER = 95
@@ -121,7 +124,7 @@ class TLogCommitRequest:
     version: int
     messages: dict[int, list[Mutation]]  # tag -> mutations for that tag
     known_committed_version: int = 0
-    epoch: int = 0
+    uid: str = ""
 
 
 @dataclass
@@ -135,7 +138,7 @@ class TLogPeekRequest:
 
     tag: int
     begin: int
-    epoch: int = 0  # generation to peek on a shared TLog host
+    uid: str = ""  # generation to peek on a shared TLog host
 
 
 @dataclass
@@ -155,7 +158,7 @@ class TLogPopRequest:
 
     tag: int
     version: int
-    epoch: int = 0  # generation to pop on a shared TLog host
+    uid: str = ""  # generation to pop on a shared TLog host
 
 
 # --- storage ---
@@ -236,10 +239,10 @@ class WatchValueRequest:
 @dataclass
 class TLogLockRequest:
     """Epoch end (ILogSystem::epochEnd): stop accepting commits; report how
-    far this log got. masterserver recoverFrom locks the old generation.
-    `epoch` is the generation being LOCKED (routing on a shared host)."""
+    far this log got. masterserver recoverFrom locks the old generation."""
 
-    epoch: int
+    epoch: int  # the NEW generation doing the locking (fence marker)
+    uid: str = ""  # generation being locked (routing on a shared host)
 
 
 @dataclass
@@ -252,12 +255,19 @@ class TLogLockReply:
 class LogEpoch:
     """One generation of the log system (LogSystemConfig.h oldTLogs entry):
     versions in (begin, end] are served by these TLogs (end None = current).
-    `epoch` is the generation number (routes requests on shared TLog hosts)."""
+    `uids` (parallel to addrs) are the per-instance generation ids that route
+    requests on shared TLog hosts — UNIQUE per recovery attempt, so racing
+    recoveries can never collide on a host (the reference's TLog UIDs in
+    LogSystemConfig). `epoch` is the generation number."""
 
     begin: int
     end: int | None
     addrs: list[str]
     epoch: int = 0
+    uids: list[str] | None = None  # None -> [""] per addr (direct clusters)
+
+    def uid_of(self, i: int) -> str:
+        return self.uids[i] if self.uids else ""
 
 
 @dataclass
@@ -283,6 +293,7 @@ class InitRoleRequest:
 @dataclass
 class InitRoleReply:
     address: str
+    incarnation: int = 0  # worker reboot count at recruit time
 
 
 @dataclass
@@ -305,3 +316,4 @@ class DBInfo:
     storages: list[tuple[str, int]]  # (address, tag)
     shard_boundaries: list[bytes]
     recovery_state: str = "unrecovered"
+    ratekeeper: str | None = None

@@ -61,8 +61,11 @@ class Master:
 
     def _on_depose(self, req, reply):
         """Fast-path fence from the recovering cluster controller; the cstate
-        lease below is the backstop when this message cannot be delivered."""
-        if req is None or req >= self.epoch:
+        lease below is the backstop when this message cannot be delivered.
+        Only STRICTLY older generations are fenced: when the new master is
+        recruited onto the old master's worker, the depose (carrying the new
+        epoch) arrives at the replacement and must not kill it."""
+        if req is None or req > self.epoch:
             self.deposed = True
         reply.send(None)
 
@@ -75,11 +78,16 @@ class Master:
         while not self.deposed:
             votes = 0
             newer = False
-            for addr in self.coordinators:
+            # probe every coordinator CONCURRENTLY: sequential timeouts would
+            # stretch a probe round past the recovery grace period when the
+            # quorum is unreachable, exactly when fast deposition matters
+            futures = [self.loop.timeout(self.process.net.request(
+                self.process, Endpoint(addr, CoordToken.GENERATION_PEEK),
+                GenReadRequest(key="cstate", gen=0)), lease / 3)
+                for addr in self.coordinators]
+            for f in futures:
                 try:
-                    r = await self.loop.timeout(self.process.net.request(
-                        self.process, Endpoint(addr, CoordToken.GENERATION_PEEK),
-                        GenReadRequest(key="cstate", gen=0)), lease / 3)
+                    r = await f
                 except FDBError as e:
                     if e.name == "operation_cancelled":
                         raise

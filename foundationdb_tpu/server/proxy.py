@@ -100,7 +100,10 @@ class Proxy:
     def __init__(self, process: SimProcess, proxy_id: int, master: Endpoint,
                  resolvers: ResolverMap, tlogs: list[Endpoint],
                  shards: ShardMap, recovery_version: int = 0,
-                 other_proxies: list[str] | None = None, epoch: int = 0):
+                 other_proxies: list[str] | None = None, epoch: int = 0,
+                 ratekeeper: str | None = None, n_proxies: int = 1,
+                 tlog_uids: list[str] | None = None,
+                 die_on_failure: bool = False):
         self.process = process
         self.loop = process.net.loop
         self.proxy_id = proxy_id
@@ -108,6 +111,7 @@ class Proxy:
         self.epoch = epoch
         self.resolvers = resolvers
         self.tlogs = tlogs
+        self.tlog_uids = tlog_uids or [""] * len(tlogs)
         self.shards = shards
         self.other_proxies = [Endpoint(a, Token.PROXY_GET_COMMITTED_VERSION)
                               for a in (other_proxies or [])]
@@ -120,16 +124,120 @@ class Proxy:
         self._batcher_armed = False
         self._master_last_seen = self.loop.now()
         self.stats = {"commits_in": 0, "committed": 0, "conflicts": 0, "too_old": 0}
+        self._infra_failures = 0
+        # suicide-on-pipeline-failure only makes sense when a cluster
+        # controller exists to observe the death and rebuild the generation;
+        # statically-built clusters retry instead (their topology heals)
+        self.die_on_failure = die_on_failure
+        self.dead = False
         process.register(Token.PROXY_COMMIT, self._on_commit)
         process.register(Token.PROXY_GET_READ_VERSION, self._on_grv)
         process.register(Token.PROXY_GET_COMMITTED_VERSION,
                          self._on_get_committed_version)
+        process.register(Token.PROXY_PING, self._on_proxy_ping)
         self._lease_task = process.spawn(self._master_lease_loop(), "masterLease")
+        self._last_flush = self.loop.now()
+        # idle empty batches (the reference's MAX_COMMIT_BATCH_INTERVAL
+        # flush): commit versions advance with the clock at 1M/s, so if no
+        # batch ever commits the committed version (and with it every new
+        # read version) falls behind the resolvers' MVCC window and ALL
+        # transactions become transaction_too_old — a livelock after any
+        # multi-second outage. Empty batches keep the pipeline's committed
+        # version moving whenever the proxy is idle. Managed (CC-recruited)
+        # proxies only: in a static cluster a crashed-and-rebooted TLog
+        # rejoins at its old version, and keepalive batches allocated during
+        # the outage would leave it a permanent version-chain gap that only
+        # a recovery (new generation) could clear.
+        self._empty_task = None
+        if die_on_failure:
+            self._empty_task = process.spawn(self._empty_batch_loop(),
+                                             "emptyBatch")
+        # admission control (transactionStarter :985 + getRate :86): a token
+        # bucket fed by the ratekeeper gates read-version handouts
+        self.ratekeeper = ratekeeper
+        self.n_proxies = n_proxies
+        self._rk_tps: float | None = None
+        self._grv_tokens = 1.0
+        self._grv_queue: list = []
+        self._rk_tasks = []
+        if ratekeeper is not None:
+            self._rk_tasks = [
+                process.spawn(self._rk_fetch_loop(), "getRate"),
+                process.spawn(self._grv_pump(), "transactionStarter")]
 
     def shutdown(self):
         """Displaced by a newer generation on the same worker."""
         self._lease_task.cancel()
+        if self._empty_task is not None:
+            self._empty_task.cancel()
+        for t in self._rk_tasks:
+            t.cancel()
         self._master_last_seen = float("-inf")  # fence immediately
+        queued, self._grv_queue = self._grv_queue, []
+        for reply in queued:  # don't strand throttled waiters until timeout
+            reply.send_error(FDBError("cluster_not_fully_recovered",
+                                      "proxy shut down"))
+
+    def _on_proxy_ping(self, req, reply):
+        reply.send(self.epoch)
+
+    def die(self, reason: str):
+        """The reference's commit-path contract: a proxy whose pipeline keeps
+        failing (resolver or TLog unreachable) dies, the master/CC observes
+        the death, and a recovery rebuilds the generation — the failure is
+        never allowed to smolder as endless commit_unknown_result."""
+        if self.dead:
+            return
+        self.dead = True
+        from foundationdb_tpu.utils.trace import TraceEvent
+        TraceEvent("ProxyDied", self.process.address) \
+            .detail("Reason", reason).detail("Epoch", self.epoch).log()
+        for token in (Token.PROXY_COMMIT, Token.PROXY_GET_READ_VERSION,
+                      Token.PROXY_GET_COMMITTED_VERSION, Token.PROXY_PING):
+            self.process.deregister(token)
+        self.shutdown()
+
+    async def _empty_batch_loop(self):
+        interval = KNOBS.COMMIT_BATCH_IDLE_INTERVAL
+        while True:
+            await self.loop.delay(interval)
+            if (self.loop.now() - self._last_flush >= interval
+                    and not self._pending and self._master_live()):
+                self._flush()
+
+    # -- admission control --
+
+    async def _rk_fetch_loop(self):
+        ep = Endpoint(self.ratekeeper, Token.RK_GET_RATE)
+        while True:
+            try:
+                r = await self.loop.timeout(self.process.net.request(
+                    self.process, ep, self.n_proxies), 1.0)
+                self._rk_tps = r.tps
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+            await self.loop.delay(KNOBS.RK_UPDATE_INTERVAL)
+
+    async def _grv_pump(self):
+        interval = 0.05
+        while True:
+            await self.loop.delay(interval)
+            if self._rk_tps is not None:
+                burst = max(1.0, self._rk_tps * 0.2)
+                self._grv_tokens = min(self._grv_tokens
+                                       + self._rk_tps * interval, burst)
+            while self._grv_queue and self._grv_tokens >= 1.0:
+                self._grv_tokens -= 1.0
+                reply = self._grv_queue.pop(0)
+                # the lease can expire while a request waits in line; serving
+                # it anyway would hand out a deposed generation's stale
+                # committed version past the recovery grace period
+                if self._master_live():
+                    self._serve_grv(reply)
+                else:
+                    reply.send_error(FDBError("cluster_not_fully_recovered",
+                                              "proxy lost its master"))
 
     # -- master liveness lease --
     # A proxy whose master is unreachable (dead, or replaced by a recovery)
@@ -166,6 +274,17 @@ class Proxy:
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy lost its master"))
             return
+        if self._rk_tps is not None:
+            # ratekeeper-gated: spend a token or wait in line
+            if not self._grv_queue and self._grv_tokens >= 1.0:
+                self._grv_tokens -= 1.0
+                self._serve_grv(reply)
+            else:
+                self._grv_queue.append(reply)
+            return
+        self._serve_grv(reply)
+
+    def _serve_grv(self, reply):
         if not self.other_proxies:
             reply.send(GetReadVersionReply(version=self.committed_version.get()))
             return
@@ -207,6 +326,7 @@ class Proxy:
     def _flush(self):
         batch, self._pending = self._pending, []
         self._batch_n += 1
+        self._last_flush = self.loop.now()
         self.process.spawn(self._commit_batch(self._batch_n, batch), "commitBatch")
 
     # -- the 5-phase pipeline --
@@ -218,9 +338,23 @@ class Proxy:
             # ---- Phase 1: pre-resolution (:363) ----
             await self.latest_resolving.when_at_least(batch_n - 1)
             self._request_num += 1
-            ver = await self.process.net.request(
-                self.process, self.master,
-                GetCommitVersionRequest(self.proxy_id, self._request_num))
+            # RETRY the version fetch with the SAME request_num until the
+            # master answers (it dedupes retransmits :834-843): a timed-out
+            # fetch still ASSIGNED the version on the master, and abandoning
+            # it would leave a permanent gap in the resolvers' prevVersion
+            # chain that wedges every later batch
+            req = GetCommitVersionRequest(self.proxy_id, self._request_num)
+            ver = None
+            while ver is None:
+                try:
+                    ver = await self.process.net.request(
+                        self.process, self.master, req)
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                    if not self._master_live():
+                        raise  # master gone: recovery will replace us
+                    await self.loop.delay(0.2)
             commit_version, prev_version = ver.version, ver.prev_version
 
             n_res = len(self.resolvers.endpoints)
@@ -286,12 +420,13 @@ class Proxy:
                         prev_version=prev_version, version=commit_version,
                         messages=messages,
                         known_committed_version=self.committed_version.get(),
-                        epoch=self.epoch))
-                for tl in self.tlogs]
+                        uid=uid))
+                for tl, uid in zip(self.tlogs, self.tlog_uids)]
             await self._wait_quorum(log_futures, quorum)
             self.latest_logging.set(batch_n)
 
             # ---- Phase 5: replies (:862) ----
+            self._infra_failures = 0
             if commit_version > self.committed_version.get():
                 self.committed_version.set(commit_version)
             for rep, status in zip(replies, statuses):
@@ -313,6 +448,10 @@ class Proxy:
             for rep in replies:
                 if not rep.is_set():
                     rep.send_error(FDBError("commit_unknown_result", detail))
+            if detail != "operation_cancelled":
+                self._infra_failures += 1
+                if self.die_on_failure and self._infra_failures >= 3:
+                    self.die(f"commit pipeline failing: {detail}")
 
     def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:
         if m.type == MutationType.SET_VERSIONSTAMPED_KEY:

@@ -1,0 +1,98 @@
+"""Ratekeeper: cluster-wide admission control.
+
+Reference: fdbserver/Ratekeeper.actor.cpp — updateRate (:250) computes a
+transactions-per-second budget from smoothed storage durability lag and TLog
+queue depth; proxies fetch it with GetRateInfoRequest (rateKeeper :508 /
+MasterProxyServer getRate :86) and gate read-version handouts with it, which
+throttles ingest at the front door instead of letting server queues grow
+without bound.
+
+Here the worst storage lag (latest applied version - durable version) and the
+worst TLog in-memory queue depth each scale the budget down proportionally
+when they exceed their targets; the final rate is the min of the two,
+exponentially smoothed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.sim import Endpoint, SimProcess
+from foundationdb_tpu.server.interfaces import Token
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@dataclass
+class RateInfoReply:
+    tps: float  # transaction starts per second this proxy may grant
+
+
+@dataclass
+class QueueStatsReply:
+    """TLog/storage health sample (TLogQueuingMetrics / StorageQueuingMetrics)."""
+
+    queue_bytes: int = 0  # TLog: un-popped in-memory bytes
+    lag_versions: int = 0  # storage: version - durable_version
+
+
+class Ratekeeper:
+    def __init__(self, process: SimProcess,
+                 tlogs: list[str] | None = None,
+                 storages: list[str] | None = None):
+        self.process = process
+        self.loop = process.net.loop
+        self.tlogs = list(tlogs or [])
+        self.storages = list(storages or [])
+        self.tps = KNOBS.RK_BASE_TPS
+        self.stats = {"worst_tlog_bytes": 0, "worst_storage_lag": 0}
+        process.register(Token.RK_GET_RATE, self._on_get_rate)
+        self._task = process.spawn(self._update_loop(), "rateKeeper")
+
+    def shutdown(self):
+        self._task.cancel()
+
+    def _on_get_rate(self, req, reply):
+        n = max(1, req if isinstance(req, int) else 1)  # proxies share the budget
+        reply.send(RateInfoReply(tps=self.tps / n))
+
+    async def _sample(self, addr: str) -> QueueStatsReply | None:
+        try:
+            return await self.loop.timeout(self.process.net.request(
+                self.process, Endpoint(addr, Token.QUEUE_STATS), None), 1.0)
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            return None
+
+    async def _update_loop(self):
+        smoothing = KNOBS.RK_SMOOTHING
+        while True:
+            # sample everyone concurrently: sequential 1s timeouts would slow
+            # the control loop to O(n) seconds exactly when servers are dead
+            log_f = [self.loop.spawn(self._sample(a), "rkSample")
+                     for a in self.tlogs]
+            lag_f = [self.loop.spawn(self._sample(a), "rkSample")
+                     for a in self.storages]
+            worst_log = 0
+            for f in log_f:
+                s = await f
+                if s is not None:
+                    worst_log = max(worst_log, s.queue_bytes)
+            worst_lag = 0
+            for f in lag_f:
+                s = await f
+                if s is not None:
+                    worst_lag = max(worst_lag, s.lag_versions)
+            self.stats["worst_tlog_bytes"] = worst_log
+            self.stats["worst_storage_lag"] = worst_lag
+
+            scale = 1.0
+            if worst_log > KNOBS.RK_TARGET_TLOG_BYTES:
+                scale = min(scale, KNOBS.RK_TARGET_TLOG_BYTES / worst_log)
+            if worst_lag > KNOBS.RK_TARGET_STORAGE_LAG_VERSIONS:
+                scale = min(scale,
+                            KNOBS.RK_TARGET_STORAGE_LAG_VERSIONS / worst_lag)
+            target = KNOBS.RK_BASE_TPS * scale
+            self.tps = (1 - smoothing) * self.tps + smoothing * target
+            await self.loop.delay(KNOBS.RK_UPDATE_INTERVAL)

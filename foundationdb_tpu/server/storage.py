@@ -36,7 +36,8 @@ class StorageServer:
                  tlog_addrs: list[str] | None = None,
                  recovery_version: int = 0,
                  log_epochs: list[LogEpoch] | None = None,
-                 recovery_count: int = 0):
+                 recovery_count: int = 0,
+                 shard_ranges: list[tuple[bytes, bytes | None]] | None = None):
         """Pulls its tag from the log system's epoch list (version-routed:
         epoch (begin, end] served by that generation's TLogs); pops go to
         every TLog of every epoch holding the tag.
@@ -53,6 +54,11 @@ class StorageServer:
             log_epochs = [LogEpoch(begin=0, end=None, addrs=list(tlog_addrs or []))]
         self.log_epochs: list[LogEpoch] = log_epochs
         self.recovery_count = recovery_count
+        # assigned shards; None = serve everything (directly-built clusters).
+        # A request outside them gets wrong_shard_server so a client with a
+        # stale location cache re-resolves (storageserver getValueQ's
+        # serveGetValueRequests shard check).
+        self.shard_ranges = shard_ranges
         self._peek_rotation = 0  # failover index within an epoch's addrs
         self.store = MemoryKeyValueStore(
             process.net.open_file(process, f"storage-{tag}.0"),
@@ -77,6 +83,7 @@ class StorageServer:
         process.register(Token.STORAGE_GET_KEY_VALUES, self._on_get_key_values)
         process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
         process.register(Token.STORAGE_SET_LOGSYSTEM, self._on_set_logsystem)
+        process.register(Token.QUEUE_STATS, self._on_queue_stats)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
 
     def shutdown(self):
@@ -119,7 +126,8 @@ class StorageServer:
         loop = self.process.net.loop
         while True:
             epoch = self._epoch_for(self._peek_begin + 1)
-            addr = epoch.addrs[self._peek_rotation % len(epoch.addrs)]
+            idx = self._peek_rotation % len(epoch.addrs)
+            addr = epoch.addrs[idx]
             recovery_count = self.recovery_count
             try:
                 # bounded wait: a silently-dropped packet (clog/partition)
@@ -127,7 +135,7 @@ class StorageServer:
                 reply = await loop.timeout(self.process.net.request(
                     self.process, Endpoint(addr, Token.TLOG_PEEK),
                     TLogPeekRequest(tag=self.tag, begin=self._peek_begin + 1,
-                                    epoch=epoch.epoch)),
+                                    uid=epoch.uid_of(idx))),
                     2.0)
             except FDBError as e:
                 if e.name == "operation_cancelled":
@@ -185,16 +193,16 @@ class StorageServer:
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
         self.store.commit()
         self.data.forget_before(target)
-        popped: set[tuple[str, int]] = set()
+        popped: set[tuple[str, str]] = set()
         for epoch in self.log_epochs:
-            for addr in epoch.addrs:
-                if (addr, epoch.epoch) in popped:
+            for i, addr in enumerate(epoch.addrs):
+                uid = epoch.uid_of(i)
+                if (addr, uid) in popped:
                     continue
-                popped.add((addr, epoch.epoch))
+                popped.add((addr, uid))
                 self.process.net.one_way(
                     self.process, Endpoint(addr, Token.TLOG_POP),
-                    TLogPopRequest(tag=self.tag, version=target,
-                                   epoch=epoch.epoch))
+                    TLogPopRequest(tag=self.tag, version=target, uid=uid))
         # prune fully-drained generations (the reference discards a log
         # generation once every tag is popped past its end) — bounds the pop
         # fan-out as recoveries accumulate; pruned after this round's pop so
@@ -215,6 +223,24 @@ class StorageServer:
                                            m.param2))
 
     # -- reads --
+
+    def _on_queue_stats(self, req, reply):
+        """StorageQueuingMetrics for the ratekeeper: durability lag."""
+        from foundationdb_tpu.server.ratekeeper import QueueStatsReply
+        reply.send(QueueStatsReply(
+            lag_versions=self.version.get() - self.durable_version))
+
+    def _owns_key(self, key: bytes) -> bool:
+        if self.shard_ranges is None:
+            return True
+        return any(b <= key and (e is None or key < e)
+                   for b, e in self.shard_ranges)
+
+    def _owns_range(self, begin: bytes, end: bytes) -> bool:
+        if self.shard_ranges is None:
+            return True
+        return any(b <= begin and (e is None or end <= e)
+                   for b, e in self.shard_ranges)
 
     async def _wait_for_version(self, version: int) -> None:
         """waitForVersion (:654): too-new reads wait (bounded), dead reads throw.
@@ -240,6 +266,8 @@ class StorageServer:
 
     async def _get_value(self, req: GetValueRequest, reply):
         try:
+            if not self._owns_key(req.key):
+                raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
             reply.send(GetValueReply(value=self.data.get(req.key, req.version),
                                      version=req.version))
@@ -272,6 +300,8 @@ class StorageServer:
 
     async def _get_key_values(self, req: GetKeyValuesRequest, reply):
         try:
+            if not self._owns_range(req.begin.key, req.end.key):
+                raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
             begin = self._resolve_selector(req.begin, req.version)
             end = self._resolve_selector(req.end, req.version)
@@ -292,6 +322,8 @@ class StorageServer:
 
     async def _watch(self, req: WatchValueRequest, reply):
         try:
+            if not self._owns_key(req.key):
+                raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
             current = self.data.get(req.key, self.version.get())
             if current != req.value:

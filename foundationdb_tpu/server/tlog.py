@@ -9,8 +9,15 @@ reclaimed (:362 version/queueCommittedVersion).
 Durability: a DiskQueue (two alternating checksummed SimFiles,
 storage/diskqueue.py = DiskQueue.actor.cpp) — a kill loses unsynced pages
 exactly like AsyncFileNonDurable, so recovery tests mean something. Popped
-versions let the queue truncate (space reclaim). Spill-to-kvstore for
-long-lagging tags is still TODO.
+versions let the queue truncate (space reclaim).
+
+Bounded memory (updatePersistentData :548 spill + peek reply limits):
+- peek replies stop at TLOG_PEEK_REPLY_BYTES; `end` reflects only what was
+  included, so a lagging peeker pages through in bounded chunks.
+- when un-popped memory exceeds TLOG_SPILL_BYTES, the oldest entries SPILL:
+  they leave the in-memory deques but stay durable in the disk queue; a peek
+  below the in-memory floor is served by re-reading the queue (the reference
+  reads spilled messages back from the IKeyValueStore).
 """
 
 from __future__ import annotations
@@ -39,11 +46,26 @@ class TLog:
         self.queue = DiskQueue(process.net.open_file(process, file_name + ".0"),
                                process.net.open_file(process, file_name + ".1"))
         self._version_seq: deque[tuple[int, int]] = deque()  # (version, seq)
+        self._mem_bytes = 0  # payload bytes held in the in-memory deques
+        self._mem_floor: dict[int, int] = {}  # tag -> first in-memory version
+        # un-popped bytes per tag (memory + spilled): the ratekeeper's log
+        # queue signal — grows while a storage server is not consuming
+        self._tag_sizes: dict[int, deque] = {}  # tag -> deque[(version, bytes)]
+        self._tag_bytes: dict[int, int] = {}
         if register:
             process.register(Token.TLOG_COMMIT, self._on_commit)
             process.register(Token.TLOG_PEEK, self._on_peek)
             process.register(Token.TLOG_POP, self._on_pop)
             process.register(Token.TLOG_LOCK, self._on_lock)
+            process.register(Token.QUEUE_STATS, self._on_queue_stats)
+
+    def _on_queue_stats(self, req, reply):
+        """TLogQueuingMetrics for the ratekeeper: total un-popped bytes
+        (in-memory AND spilled — a lagging consumer must register even after
+        its backlog spilled out of RAM)."""
+        from foundationdb_tpu.server.ratekeeper import QueueStatsReply
+        reply.send(QueueStatsReply(
+            queue_bytes=sum(self._tag_bytes.values())))
 
     def _on_lock(self, req: TLogLockRequest, reply):
         """Epoch end: fence old-generation commits (TLogServer lock path /
@@ -76,6 +98,10 @@ class TLog:
         for tag, muts in req.messages.items():
             if muts:
                 self.messages.setdefault(tag, deque()).append((req.version, muts))
+                w = sum(m.weight() for m in muts)
+                self._mem_bytes += w
+                self._tag_sizes.setdefault(tag, deque()).append((req.version, w))
+                self._tag_bytes[tag] = self._tag_bytes.get(tag, 0) + w
         self.known_committed_version = max(self.known_committed_version,
                                            req.known_committed_version)
         # durable push + commit, then reply (group commit = one sync per batch)
@@ -83,7 +109,25 @@ class TLog:
         self.queue.commit()
         self._version_seq.append((req.version, seq))
         self.version.set(req.version)
+        self._maybe_spill()
         reply.send(TLogCommitReply(version=req.version))
+
+    def _maybe_spill(self):
+        """Evict the oldest in-memory entries once memory exceeds the spill
+        threshold; they remain durable in the disk queue and peeks below the
+        in-memory floor fall back to reading it (updatePersistentData :548)."""
+        from foundationdb_tpu.utils.knobs import KNOBS
+        while self._mem_bytes > KNOBS.TLOG_SPILL_BYTES:
+            oldest_tag = None
+            oldest_v = None
+            for tag, q in self.messages.items():
+                if q and (oldest_v is None or q[0][0] < oldest_v):
+                    oldest_v, oldest_tag = q[0][0], tag
+            if oldest_tag is None:
+                return
+            v, muts = self.messages[oldest_tag].popleft()
+            self._mem_bytes -= sum(m.weight() for m in muts)
+            self._mem_floor[oldest_tag] = v + 1
 
     def _on_peek(self, req: TLogPeekRequest, reply):
         self.process.spawn(self._peek(req, reply), "tLogPeek")
@@ -91,19 +135,63 @@ class TLog:
     async def _peek(self, req: TLogPeekRequest, reply):
         # long-poll: block until there is something at/after `begin`
         # (reference peek waits for version growth, TLogServer.actor.cpp)
+        from foundationdb_tpu.utils.knobs import KNOBS
         await self.version.when_at_least(req.begin)
-        out = [(v, list(muts)) for v, muts in self.messages.get(req.tag, ())
-               if v >= req.begin]
+        budget = KNOBS.TLOG_PEEK_REPLY_BYTES
+        tag = req.tag
+        out: list[tuple[int, list]] = []
+        last_v = req.begin - 1
+        floor = self._mem_floor.get(tag, 0)
+        if req.begin < floor:
+            # spilled range: serve from the durable queue (the disk read the
+            # reference does for spilled tags); entries are seq-ordered
+            for _seq, payload in self.queue.live_entries:
+                obj = pickle.loads(payload)
+                if isinstance(obj, dict):
+                    continue  # lock marker
+                version, messages = obj
+                if version < req.begin or version >= floor:
+                    continue
+                muts = messages.get(tag)
+                if muts:
+                    out.append((version, list(muts)))
+                    budget -= sum(m.weight() for m in muts)
+                last_v = max(last_v, version)
+                if budget <= 0:
+                    break
+            if budget <= 0:
+                reply.send(TLogPeekReply(
+                    messages=out, end=last_v + 1,
+                    popped=self.popped.get(tag, 0),
+                    known_committed_version=self.known_committed_version))
+                return
+            last_v = floor - 1  # the whole spilled gap is covered
+        for v, muts in self.messages.get(tag, ()):
+            if v <= last_v:
+                continue
+            out.append((v, list(muts)))
+            budget -= sum(m.weight() for m in muts)
+            last_v = v
+            if budget <= 0:
+                break
+        end = (last_v + 1) if budget <= 0 else self.version.get() + 1
         reply.send(TLogPeekReply(
-            messages=out, end=self.version.get() + 1,
-            popped=self.popped.get(req.tag, 0),
+            messages=out, end=end,
+            popped=self.popped.get(tag, 0),
             known_committed_version=self.known_committed_version))
 
     def _on_pop(self, req: TLogPopRequest, reply):
         self.popped[req.tag] = max(self.popped.get(req.tag, 0), req.version)
         q = self.messages.get(req.tag)
         while q and q[0][0] < req.version:
-            q.popleft()
+            _v, muts = q.popleft()
+            self._mem_bytes -= sum(m.weight() for m in muts)
+        if req.version > self._mem_floor.get(req.tag, 0):
+            self._mem_floor[req.tag] = req.version
+        sizes = self._tag_sizes.get(req.tag)
+        while sizes and sizes[0][0] < req.version:
+            _v, w = sizes.popleft()
+            self._tag_bytes[req.tag] -= w
         self._reclaim()
         reply.send(None)
 
@@ -134,9 +222,14 @@ class TLog:
             for tag, muts in messages.items():
                 if muts:
                     self.messages.setdefault(tag, deque()).append((version, muts))
+                    w = sum(m.weight() for m in muts)
+                    self._mem_bytes += w
+                    self._tag_sizes.setdefault(tag, deque()).append((version, w))
+                    self._tag_bytes[tag] = self._tag_bytes.get(tag, 0) + w
             last = max(last, version)
         if last > self.version.get():
             self.version.set(last)
+        self._maybe_spill()
         return last
 
 
@@ -152,25 +245,36 @@ class TLogHost:
 
     def __init__(self, process: SimProcess):
         self.process = process
-        self.generations: dict[int, TLog] = {}
+        self.generations: dict[str, TLog] = {}  # uid -> instance
         process.register(Token.TLOG_COMMIT, self._route(TLog._on_commit))
         process.register(Token.TLOG_PEEK, self._route(TLog._on_peek))
         process.register(Token.TLOG_POP, self._route(TLog._on_pop))
         process.register(Token.TLOG_LOCK, self._route(TLog._on_lock))
+        process.register(Token.QUEUE_STATS, self._on_queue_stats)
 
-    def add(self, epoch: int, recovery_version: int = 0,
-            file_name: str = "tlog.dq") -> TLog:
+    def _on_queue_stats(self, req, reply):
+        # un-popped bytes (memory + spilled), like the standalone handler: a
+        # lagging consumer must register even after its backlog spilled
+        from foundationdb_tpu.server.ratekeeper import QueueStatsReply
+        reply.send(QueueStatsReply(queue_bytes=sum(
+            sum(t._tag_bytes.values()) for t in self.generations.values())))
+
+    def add(self, uid: str, recovery_version: int = 0) -> TLog:
+        """uids are unique per recovery ATTEMPT (LogSystemConfig's TLog UIDs),
+        so racing recoveries can never collide on a host: a losing attempt's
+        generation simply lingers unused, exactly like the reference's stale
+        tLog instances awaiting cleanup."""
         t = TLog(self.process, recovery_version=recovery_version,
-                 file_name=file_name, register=False)
-        self.generations[epoch] = t
+                 file_name=f"tlog-{uid}.dq", register=False)
+        self.generations[uid] = t
         return t
 
     def _route(self, method):
         def handler(req, reply):
-            t = self.generations.get(req.epoch)
+            t = self.generations.get(req.uid)
             if t is None:
                 reply.send_error(FDBError("tlog_stopped",
-                                          f"no generation {req.epoch}"))
+                                          f"no generation {req.uid!r}"))
             else:
                 method(t, req, reply)
         return handler

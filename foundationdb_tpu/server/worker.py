@@ -35,7 +35,10 @@ class Worker:
     # -- liveness (waitFailureServer analogue) --
 
     def _on_ping(self, req, reply):
-        reply.send(self.process.address)
+        # the incarnation (reboot count) lets a watcher distinguish "the
+        # process is alive" from "the roles I recruited are still alive": a
+        # rebooted worker answers pings but its roles died with the process
+        reply.send(self.process.reboots)
 
     async def _register_loop(self):
         """Advertise to the current cluster controller (workerServer's
@@ -59,7 +62,8 @@ class Worker:
     def _on_init_role(self, req: InitRoleRequest, reply):
         try:
             self._make_role(req.role, req.args)
-            reply.send(InitRoleReply(address=self.process.address))
+            reply.send(InitRoleReply(address=self.process.address,
+                                     incarnation=self.process.reboots))
         except Exception as e:  # noqa: BLE001 — recruiter sees the failure
             reply.send_error(FDBError("recruitment_failed", repr(e)))
 
@@ -82,12 +86,16 @@ class Worker:
         elif role == "resolver":
             from foundationdb_tpu.server.resolver import Resolver
             self._set_role("resolver", Resolver(self.process, **args))
+        elif role == "ratekeeper":
+            from foundationdb_tpu.server.ratekeeper import Ratekeeper
+            self._set_role("ratekeeper", Ratekeeper(self.process, **args))
         elif role == "tlog":
             from foundationdb_tpu.server.tlog import TLogHost
             host = self.roles.get("tloghost")
             if host is None:
                 host = self.roles["tloghost"] = TLogHost(self.process)
-            host.add(**args)
+            host.add(uid=args["uid"],
+                     recovery_version=args.get("recovery_version", 0))
         elif role == "storage":
             from foundationdb_tpu.server.storage import StorageServer
             self._set_role(f"storage:{args['tag']}",
@@ -111,13 +119,17 @@ class Worker:
                         None), 2.0)
                     if info.recovery_state == "accepting_commits":
                         from foundationdb_tpu.server.storage import StorageServer
+                        b = info.shard_boundaries
                         for tag in tags:
                             key = f"storage:{tag}"
                             if key not in self.roles:
+                                srange = (b[tag], b[tag + 1]
+                                          if tag + 1 < len(b) else None)
                                 self.roles[key] = StorageServer(
                                     self.process, tag=tag,
                                     log_epochs=list(info.log_epochs),
-                                    recovery_count=info.epoch)
+                                    recovery_count=info.epoch,
+                                    shard_ranges=[srange])
                         return
             except FDBError:
                 pass

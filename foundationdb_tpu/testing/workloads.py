@@ -1,0 +1,248 @@
+"""Workload library + spec runner: correctness invariants under fault cocktails.
+
+Reference: fdbserver/workloads/workloads.h (:55-72 TestWorkload's
+setup/start/check phases), fdbserver/workloads/Cycle.actor.cpp (:27-80 the
+serializability ring), RandomClogging.actor.cpp, MachineAttrition.actor.cpp,
+and the spec grammar of tests/fast/CycleTest.txt (a correctness workload runs
+IN PARALLEL with fault workloads; at the end the cluster quiesces and check()
+asserts the invariant). Swizzle-clogging (tests/slow/SwizzledCycleTest.txt,
+documentation/sphinx/source/testing.rst): clog a whole set of links, then
+unclog in reverse order — a rolling partial partition.
+
+Every workload draws randomness ONLY from the forked DeterministicRandom it
+is given, so a failing (seed, spec) pair replays identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.future import all_of
+from foundationdb_tpu.core.sim import KillType
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+class Workload:
+    """setup() -> start() (runs until stop_at) -> check() after quiesce."""
+
+    name = "workload"
+
+    def init(self, cluster, rng, stop_at: float):
+        self.cluster = cluster
+        self.rng = rng
+        self.stop_at = stop_at
+
+    async def setup(self, db):
+        pass
+
+    async def start(self, db):
+        pass
+
+    async def check(self, db):
+        pass
+
+    def _time_left(self) -> bool:
+        return self.cluster.loop.now() < self.stop_at
+
+
+class CycleWorkload(Workload):
+    """N keys form a ring by value; transactional 3-key rotations preserve
+    the ring under ANY interleaving iff the system is serializable."""
+
+    name = "Cycle"
+
+    def __init__(self, n_keys: int = 5, prefix: bytes = b"cycle/"):
+        self.n = n_keys
+        self.prefix = prefix
+        self.rotations = 0
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%02d" % i
+
+    async def setup(self, db):
+        async def fn(tr):
+            for i in range(self.n):
+                tr.set(self.key(i), b"%02d" % ((i + 1) % self.n))
+        await db.transact(fn)
+
+    async def start(self, db):
+        while self._time_left():
+            async def rotate(tr):
+                r = self.rng.randint(0, self.n - 1)
+                a = self.key(r)
+                b_idx = int(await tr.get(a))
+                b = self.key(b_idx)
+                c_idx = int(await tr.get(b))
+                ck = self.key(c_idx)
+                d_idx = int(await tr.get(ck))
+                tr.set(a, b"%02d" % c_idx)
+                tr.set(b, b"%02d" % d_idx)
+                tr.set(ck, b"%02d" % b_idx)
+            await db.transact(rotate, max_retries=2000)
+            self.rotations += 1
+            await self.cluster.loop.delay(0.05 * self.rng.random())
+
+    async def check(self, db):
+        async def read_ring(tr):
+            seen = set()
+            i = 0
+            for _ in range(self.n):
+                seen.add(i)
+                i = int(await tr.get(self.key(i)))
+            return i, seen
+        i, seen = await db.transact(read_ring, max_retries=1000)
+        assert i == 0 and len(seen) == self.n, \
+            f"ring broken after {self.rotations} rotations: {seen}"
+        assert self.rotations > 0, "workload made no progress"
+
+
+class RandomCloggingWorkload(Workload):
+    """Randomly clog links between cluster processes (RandomClogging)."""
+
+    name = "RandomClogging"
+
+    def __init__(self, interval: float = 2.0, max_seconds: float = 2.5):
+        self.interval = interval
+        self.max_seconds = max_seconds
+
+    async def start(self, db):
+        procs = [p.address for p in self.cluster.worker_procs] + \
+                [p.address for p in self.cluster.storage_worker_procs]
+        while self._time_left():
+            await self.cluster.loop.delay(self.interval * (0.5 + self.rng.random()))
+            a = procs[self.rng.randint(0, len(procs) - 1)]
+            b = procs[self.rng.randint(0, len(procs) - 1)]
+            if a != b:
+                self.cluster.net.clog_pair(a, b, self.max_seconds * self.rng.random())
+
+
+class SwizzleCloggingWorkload(Workload):
+    """Clog a random subset of processes' links one at a time, then unclog in
+    reverse order ("swizzle", testing.rst) — catches recovery paths that only
+    work when failures resolve in FIFO order."""
+
+    name = "SwizzledClogging"
+
+    def __init__(self, interval: float = 5.0):
+        self.interval = interval
+
+    async def start(self, db):
+        loop = self.cluster.loop
+        procs = [p.address for p in self.cluster.worker_procs]
+        while self._time_left():
+            await loop.delay(self.interval * (0.5 + self.rng.random()))
+            subset = [a for a in procs if self.rng.coinflip(0.5)]
+            self.rng.shuffle(subset)
+            cloggged = []
+            for a in subset:
+                for b in procs:
+                    if a != b:
+                        self.cluster.net.clog_pair(a, b, 30.0)
+                cloggged.append(a)
+                await loop.delay(0.3 * self.rng.random())
+            for a in reversed(cloggged):
+                # unclog by re-clogging with 0 duration is not possible;
+                # heal link-by-link via the clog map
+                for b in procs:
+                    self.cluster.net._clogged_until.pop((a, b), None)
+                    self.cluster.net._clogged_until.pop((b, a), None)
+                await loop.delay(0.3 * self.rng.random())
+
+
+class AttritionWorkload(Workload):
+    """Kill/reboot transaction-subsystem processes at random intervals
+    (MachineAttrition). Storage workers only get REBOOTS (single-replica
+    data must survive); stateless/tlog workers get hard kills followed by a
+    delayed reboot so capacity returns."""
+
+    name = "Attrition"
+
+    def __init__(self, interval: float = 6.0):
+        self.interval = interval
+
+    async def start(self, db):
+        loop = self.cluster.loop
+        while self._time_left():
+            await loop.delay(self.interval * (0.5 + self.rng.random()))
+            if self.rng.coinflip(0.3):
+                victim = self.cluster.storage_worker_procs[
+                    self.rng.randint(0, len(self.cluster.storage_worker_procs) - 1)]
+                TraceEvent("AttritionReboot", victim.address).log()
+                self.cluster.net.kill(victim.address, KillType.RebootProcess)
+            else:
+                victim = self.cluster.worker_procs[
+                    self.rng.randint(0, len(self.cluster.worker_procs) - 1)]
+                TraceEvent("AttritionKill", victim.address).log()
+                self.cluster.net.kill(victim.address, KillType.RebootProcess)
+
+
+@dataclass
+class SpecResult:
+    seed: int
+    rotations: int
+    epochs: int
+    elapsed: float
+
+
+def run_spec(seed: int, workloads: list[Workload] | None = None,
+             duration: float = 60.0, buggify: bool = True,
+             max_time: float = 600_000.0, **cluster_kw) -> SpecResult:
+    """Boot a RecoverableCluster, run `workloads` in parallel for `duration`
+    virtual seconds, quiesce (heal + wait for a recovered generation), then
+    run every workload's check(). The whole run is a pure function of
+    (seed, spec): the reference's `fdbserver -r simulation -f spec.txt`.
+    """
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    from foundationdb_tpu.utils.rng import DeterministicRandom
+
+    rng = DeterministicRandom(seed)
+    if buggify:
+        KNOBS.buggify(rng.fork())
+    if workloads is None:
+        workloads = [CycleWorkload(), RandomCloggingWorkload(),
+                     AttritionWorkload()]
+
+    cluster_kw.setdefault("n_workers", 5)
+    cluster_kw.setdefault("n_proxies", 2)
+    cluster_kw.setdefault("n_tlogs", 2)
+    cluster_kw.setdefault("n_storage", 2)
+    c = RecoverableCluster(seed=rng.randint(0, 1 << 30), **cluster_kw)
+    db = c.database()
+
+    async def spec():
+        await db.refresh(max_wait=120.0)
+        stop_at = c.loop.now() + duration
+        for w in workloads:
+            w.init(c, rng.fork(), stop_at)
+        for w in workloads:
+            await w.setup(db)
+        await all_of([c.loop.spawn(w.start(db), name=w.name)
+                      for w in workloads])
+        # quiesce (QuietDatabase): heal every fault, then wait until a CC
+        # reaches accepting_commits and transactions flow again
+        c.net.heal()
+        for p in c.worker_procs + c.storage_worker_procs + c.coord_procs:
+            if not p.alive:
+                c.net.reboot(p.address)
+        for _ in range(600):
+            if c.current_cc() is not None:
+                try:
+                    async def probe(tr):
+                        await tr.get(b"\x00quiesce-probe")
+                    await db.transact(probe, max_retries=50)
+                    break
+                except FDBError:
+                    pass
+            await c.loop.delay(0.5)
+        for w in workloads:
+            await w.check(db)
+
+    c.run(c.loop.spawn(spec()), max_time=max_time)
+    cyc = next((w for w in workloads if isinstance(w, CycleWorkload)), None)
+    cc = c.current_cc()
+    return SpecResult(seed=seed,
+                      rotations=cyc.rotations if cyc else 0,
+                      epochs=cc.dbinfo.epoch if cc else -1,
+                      elapsed=c.loop.now())

@@ -52,7 +52,10 @@ _ERRORS = {
     # Internal to the pipeline (not in the reference's numbering):
     "future_version": (1009, True),
     "wrong_shard_server": (1037, False),
-    "request_maybe_delivered": (1038, False),
+    # a dropped/unanswered RPC: the request may or may not have been
+    # delivered; safe to retry at the transaction level (the reference's
+    # request_maybe_delivered contract for idempotent/retried requests)
+    "request_maybe_delivered": (1038, True),
     "master_recovery_failed": (1200, False),
     "master_tlog_failed": (1201, False),
     "master_proxy_failed": (1204, False),

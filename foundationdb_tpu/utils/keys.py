@@ -98,6 +98,15 @@ def key_after(key: bytes) -> bytes:
     return key + b"\x00"
 
 
+def partition_boundaries(n: int) -> list[bytes]:
+    """n contiguous key-space partitions: [b""] + n-1 single-byte cuts.
+    Shared by cluster builders, the recovery recruiter, and tests so shard
+    layouts can never drift between them."""
+    if n <= 1:
+        return [b""]
+    return [b""] + [bytes([int(256 * i / n)]) for i in range(1, n)]
+
+
 def partition_index(boundaries: list[bytes], key: bytes) -> int:
     """Index of the partition owning `key` for sorted begin-boundaries
     (boundaries[0] == b""). Shared by shard maps, resolver maps, and the

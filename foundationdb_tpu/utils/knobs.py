@@ -73,6 +73,7 @@ KNOBS.init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, (1, 4))
 KNOBS.init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001, (0.1,))
 KNOBS.init("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.010)
 KNOBS.init("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 100_000)
+KNOBS.init("COMMIT_BATCH_IDLE_INTERVAL", 0.25)  # empty-batch keepalive
 
 # --- Conflict engine (device) ---
 KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "oracle" (CPU reference)
@@ -92,6 +93,7 @@ KNOBS.init("TRANSACTION_SIZE_LIMIT", 10_000_000)
 
 # --- Transport / simulation (flow/Knobs.cpp:51-52, fdbrpc/sim2.actor.cpp) ---
 KNOBS.init("CONNECTION_MONITOR_TIMEOUT", 2.0, (0.1,))
+KNOBS.init("SIM_RPC_TIMEOUT_SECONDS", 5.0)  # dropped-packet visibility bound
 KNOBS.init("SIM_MIN_LATENCY", 0.0001)
 KNOBS.init("SIM_MAX_LATENCY", 0.002, (0.05,))
 KNOBS.init("SIM_CLOG_PROBABILITY", 0.0)
@@ -99,6 +101,15 @@ KNOBS.init("BUGGIFY_ENABLED", False)
 
 # --- TLog / storage ---
 KNOBS.init("TLOG_QUORUM_ANTIQUORUM", 0)
+KNOBS.init("TLOG_PEEK_REPLY_BYTES", 150_000, (10_000,))  # bounded peek pages
+KNOBS.init("TLOG_SPILL_BYTES", 1_500_000, (100_000,))  # in-memory cap per log
+
+# --- Ratekeeper (fdbserver/Ratekeeper.actor.cpp updateRate :250) ---
+KNOBS.init("RK_UPDATE_INTERVAL", 0.5)
+KNOBS.init("RK_TARGET_STORAGE_LAG_VERSIONS", 10_000_000)  # worst durability lag
+KNOBS.init("RK_TARGET_TLOG_BYTES", 2_000_000, (200_000,))  # worst log queue
+KNOBS.init("RK_BASE_TPS", 100_000.0)  # unthrottled budget
+KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
 KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)
 KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
 

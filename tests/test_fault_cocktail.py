@@ -1,0 +1,41 @@
+"""Spec-driven fault-cocktail runs: Cycle + clogging + attrition, seeded.
+
+Reference: tests/fast/CycleTest.txt (Cycle paired with RandomClogging +
+Attrition under buggified knobs) and tests/slow/SwizzledCycleTest.txt; the
+determinism contract of testing.rst — a failing seed replays identically.
+"""
+
+import pytest
+
+from foundationdb_tpu.testing import (
+    AttritionWorkload, CycleWorkload, RandomCloggingWorkload,
+    SwizzleCloggingWorkload, run_spec)
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_cycle_with_clogging_and_attrition(seed):
+    r = run_spec(seed, duration=45.0)
+    assert r.rotations > 0
+
+
+def test_swizzled_cycle():
+    r = run_spec(7, workloads=[CycleWorkload(), SwizzleCloggingWorkload()],
+                 duration=40.0)
+    assert r.rotations > 0
+
+
+def test_spec_runs_are_deterministic():
+    """Same seed => identical outcome (rotation count, epochs, virtual end
+    time) — the replayability contract the whole test strategy rests on."""
+    a = run_spec(55, duration=30.0)
+    KNOBS.reset()
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    b = run_spec(55, duration=30.0)
+    assert (a.rotations, a.epochs, a.elapsed) == (b.rotations, b.epochs, b.elapsed)

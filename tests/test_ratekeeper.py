@@ -1,0 +1,114 @@
+"""Ratekeeper admission control + TLog memory bounds.
+
+Reference: fdbserver/Ratekeeper.actor.cpp updateRate (:250) / rateKeeper
+(:508); TLogServer.actor.cpp spill (updatePersistentData :548) and bounded
+peek replies. Nothing may grow without bound when a storage server lags:
+the TLog spills to its durable queue and the ratekeeper throttles ingest.
+"""
+
+import pytest
+
+from foundationdb_tpu.server.cluster import RecoverableCluster, SimCluster
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+def test_tlog_spill_and_bounded_peek_with_lagging_storage():
+    """A storage cut off from the TLogs makes the log queue grow; the TLog
+    must spill (bounded memory) and, once the storage is healed, serve the
+    spilled versions back through bounded peek pages with no lost data."""
+    KNOBS.set("TLOG_SPILL_BYTES", 2_000)
+    KNOBS.set("TLOG_PEEK_REPLY_BYTES", 500)
+    c = SimCluster(seed=7, n_tlogs=1, n_storage=1)
+    db = c.database()
+    tlog = c.tlogs[0]
+    storage_addr = c.storage_procs[0].address
+    tlog_addr = c.tlog_procs[0].address
+
+    async def t():
+        # cut the storage off from the log so it cannot pop
+        c.net.partition(storage_addr, tlog_addr)
+        c.net.partition(tlog_addr, storage_addr)
+
+        async def writes(tr):
+            for i in range(40):
+                tr.set(b"k%03d" % i, b"x" * 50)
+        await db.transact(writes)
+        async def writes2(tr):
+            for i in range(40, 80):
+                tr.set(b"k%03d" % i, b"x" * 50)
+        await db.transact(writes2)
+
+        assert tlog._mem_bytes <= KNOBS.TLOG_SPILL_BYTES, \
+            f"TLog memory unbounded: {tlog._mem_bytes}"
+        assert tlog._mem_floor.get(0, 0) > 0, "nothing was spilled"
+
+        # heal; the storage catches up from the spilled + in-memory ranges
+        c.net.heal()
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"k", b"l")
+        assert len(rows) == 80
+        assert rows[0] == (b"k000", b"x" * 50)
+        assert rows[-1] == (b"k079", b"x" * 50)
+
+    c.run(c.loop.spawn(t()), max_time=10_000.0)
+
+
+def test_ratekeeper_throttles_on_log_backlog_and_recovers():
+    """A storage server that stops consuming makes the TLog's un-popped
+    byte queue grow past its target; the ratekeeper cuts the TPS budget,
+    and after the cluster heals it returns to the base rate (updateRate's
+    proportional control)."""
+    KNOBS.set("RK_TARGET_TLOG_BYTES", 500)
+    c = RecoverableCluster(seed=21, n_workers=4, n_proxies=1, n_tlogs=1,
+                           n_storage=1)
+    db = c.database()
+
+    def rk():
+        cc = c.current_cc()
+        if cc is None or cc.dbinfo.ratekeeper is None:
+            return None
+        proc = c.net.processes[cc.dbinfo.ratekeeper]
+        return proc.worker.roles.get("ratekeeper")
+
+    async def t():
+        await db.refresh()
+
+        async def write(tr):
+            tr.set(b"a", b"1")
+        await db.transact(write)
+
+        # cut the storage off from the TLogs: its durability lag now grows
+        # with every committed version
+        info = c.current_cc().dbinfo
+        saddr = info.storages[0][0]
+        for t_addr in info.log_epochs[-1].addrs:
+            c.net.partition(saddr, t_addr)
+            c.net.partition(t_addr, saddr)
+        # keep committing blind writes (no reads -> no storage dependency)
+        for i in range(30):
+            async def w(tr, i=i):
+                tr.set(b"k%02d" % i, b"v" * 30)
+            await db.transact(w)
+            await c.loop.delay(0.5)
+
+        r = rk()
+        assert r is not None
+        throttled_tps = r.tps
+        assert r.stats["worst_tlog_bytes"] > KNOBS.RK_TARGET_TLOG_BYTES
+        assert throttled_tps < 0.9 * KNOBS.RK_BASE_TPS, \
+            f"no throttling: {throttled_tps}"
+
+        c.net.heal()
+        for _ in range(60):
+            if rk() and rk().tps > 0.9 * KNOBS.RK_BASE_TPS:
+                break
+            await c.loop.delay(0.5)
+        assert rk().tps > 0.9 * KNOBS.RK_BASE_TPS, "rate did not recover"
+
+    c.run(c.loop.spawn(t()), max_time=60_000.0)
