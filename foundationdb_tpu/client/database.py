@@ -25,42 +25,47 @@ from foundationdb_tpu.utils.rng import DeterministicRandom
 
 
 class LocationCache:
-    """Client-side shard map: sorted begin-boundaries -> storage address.
+    """Client-side shard map: sorted begin-boundaries -> storage team
+    (replica address list).
 
     The cache is a HINT (NativeAPI keyServersInfo cache): a stale entry makes
     a storage server answer wrong_shard_server, which invalidates the cache;
-    the next access re-resolves through the cluster (refresh)."""
+    the next access re-resolves through the cluster (refresh). Reads
+    load-balance across a shard's replicas and fail over on errors
+    (fdbrpc/LoadBalance.actor.h:159)."""
 
     def __init__(self, boundaries: list[bytes] | None = None,
-                 addrs: list[str] | None = None):
+                 teams: list | None = None):
         self.boundaries = list(boundaries or [])
-        self.addrs = list(addrs or [])
+        # each entry: list of replica addresses (a bare str is promoted)
+        self.teams = [[t] if isinstance(t, str) else list(t)
+                      for t in (teams or [])]
 
     @property
     def valid(self) -> bool:
         return bool(self.boundaries)
 
-    def update(self, boundaries: list[bytes], addrs: list[str]):
+    def update(self, boundaries: list[bytes], teams: list):
         self.boundaries = list(boundaries)
-        self.addrs = list(addrs)
+        self.teams = [[t] if isinstance(t, str) else list(t) for t in teams]
 
     def invalidate(self):
         self.boundaries = []
-        self.addrs = []
+        self.teams = []
 
-    def locate(self, key: bytes) -> tuple[str, bytes | None]:
-        """(owner address, end of the containing shard; None = +inf)."""
+    def locate(self, key: bytes) -> tuple[list[str], bytes | None]:
+        """(replica addresses, end of the containing shard; None = +inf)."""
         i = keylib.partition_index(self.boundaries, key)
         end = self.boundaries[i + 1] if i + 1 < len(self.boundaries) else None
-        return self.addrs[i], end
+        return self.teams[i], end
 
-    def locate_before(self, end: bytes) -> tuple[str, bytes]:
+    def locate_before(self, end: bytes) -> tuple[list[str], bytes]:
         """Shard containing keys strictly below `end` (reverse iteration):
-        (owner address, begin of that shard)."""
+        (replica addresses, begin of that shard)."""
         i = keylib.partition_index(self.boundaries, end)
         if self.boundaries[i] == end and i > 0:
             i -= 1
-        return self.addrs[i], self.boundaries[i]
+        return self.teams[i], self.boundaries[i]
 
 
 # Errors that mean "the cluster moved under us": refresh the cluster layout
@@ -147,13 +152,24 @@ class Database:
                         boundaries = list(info.shard_boundaries)
                         self.locations.update(
                             boundaries,
-                            [addr_of_tag[i] for i in range(len(boundaries))])
+                            [[addr_of_tag[t] for t in team]
+                             for team in info.teams()])
                         return
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise
             await self.loop.delay(0.5)
         raise FDBError("coordinators_changed", "no recovered cluster found")
+
+    async def get_status(self) -> dict:
+        """Cluster status JSON via the elected CC (StatusClient.actor.cpp /
+        the \\xff\\xff/status/json read)."""
+        from foundationdb_tpu.server.coordination import get_leader
+        leader = await get_leader(self.process, self.coordinators)
+        if leader is None:
+            raise FDBError("coordinators_changed", "no leader for status")
+        return await self.loop.timeout(self.process.net.request(
+            self.process, Endpoint(leader, Token.CC_GET_STATUS), None), 5.0)
 
     # -- RPC plumbing used by Transaction --
 
@@ -194,17 +210,45 @@ class Database:
                 raise FDBError("cluster_not_fully_recovered", "no layout known")
             await self.refresh()
 
+    def _team_order(self, team: list[str]) -> list[str]:
+        """Load balance: random first replica, the rest as failover backups
+        (loadBalance's firstRequest/backupRequest pattern)."""
+        if len(team) <= 1:
+            return list(team)
+        start = self._rng.randint(0, len(team) - 1)
+        return team[start:] + team[:start]
+
+    async def _on_team(self, team: list[str], fn):
+        """Run `await fn(addr)` against the team with replica failover: a
+        down replica (broken_promise / dropped packet) falls over to the
+        next member; wrong_shard_server escapes for the caller's cache
+        re-resolution; anything else propagates. THE single failover policy
+        for every read path (loadBalance, fdbrpc/LoadBalance.actor.h:159)."""
+        last: FDBError | None = None
+        for addr in self._team_order(team):
+            try:
+                return await fn(addr)
+            except FDBError as e:
+                if e.name in ("operation_cancelled", "wrong_shard_server"):
+                    raise
+                last = e
+                if e.name in ("broken_promise", "request_maybe_delivered"):
+                    continue  # replica down: try the next team member
+                raise
+        raise last or FDBError("all_alternatives_failed")
+
     async def _storage_request(self, key: bytes, token: int, req,
                                max_attempts: int = 5):
-        """Locate `key`'s shard and send; wrong_shard_server (stale cache
-        after a shard move) or a dead owner invalidates and re-resolves
-        (NativeAPI:1177 getValue's wrong_shard_server retry)."""
+        """Locate `key`'s team and send with failover; wrong_shard_server
+        (stale cache after a shard move) invalidates and re-resolves
+        (NativeAPI:1177 getValue's retry)."""
         for _ in range(max_attempts):
             await self._ensure_locations()
-            addr, _end = self.locations.locate(key)
+            team, _end = self.locations.locate(key)
             try:
-                return await self.process.net.request(
-                    self.process, Endpoint(addr, token), req)
+                return await self._on_team(
+                    team, lambda addr: self.process.net.request(
+                        self.process, Endpoint(addr, token), req))
             except FDBError as e:
                 if e.name == "wrong_shard_server" and self.coordinators:
                     self.locations.invalidate()
@@ -238,15 +282,19 @@ class Database:
             return await self.process.net.request(
                 self.process, Endpoint(addr, Token.STORAGE_GET_KEY_VALUES), sub)
 
+        async def fetch_team(team, lo, hi):
+            return await self._on_team(
+                team, lambda addr: fetch(addr, lo, hi))
+
         attempts = 0
         if not req.reverse:
             cur = begin
             while cur < end:
                 await self._ensure_locations()
-                addr, shard_end = self.locations.locate(cur)
+                team, shard_end = self.locations.locate(cur)
                 hi = end if shard_end is None else min(end, shard_end)
                 try:
-                    reply = await fetch(addr, cur, hi)
+                    reply = await fetch_team(team, cur, hi)
                 except FDBError as e:
                     if e.name == "wrong_shard_server" and self.coordinators \
                             and attempts < 5:
@@ -270,10 +318,10 @@ class Database:
         cur = end
         while begin < cur:
             await self._ensure_locations()
-            addr, shard_begin = self.locations.locate_before(cur)
+            team, shard_begin = self.locations.locate_before(cur)
             lo = max(begin, shard_begin)
             try:
-                reply = await fetch(addr, lo, cur)
+                reply = await fetch_team(team, lo, cur)
             except FDBError as e:
                 if e.name == "wrong_shard_server" and self.coordinators \
                         and attempts < 5:
@@ -295,13 +343,27 @@ class Database:
 
     def _watch(self, req: WatchValueRequest) -> Future:
         async def watch():
-            await self._ensure_locations()
-            addr, _end = self.locations.locate(req.key)
-            # watches are deliberately unbounded waits (watchValueQ blocks
-            # until the value changes): exempt from the default RPC timeout
-            return await self.process.net.request(
-                self.process, Endpoint(addr, Token.STORAGE_WATCH_VALUE), req,
-                timeout=None)
+            # same failover/re-resolution as other reads; the accepted wait
+            # itself is unbounded (watchValueQ blocks until the value
+            # changes), so only the request's DELIVERY is fenced: a replica
+            # that dies while holding the watch surfaces broken_promise and
+            # fails over to another team member
+            for _ in range(5):
+                await self._ensure_locations()
+                team, _end = self.locations.locate(req.key)
+                try:
+                    return await self._on_team(
+                        team, lambda addr: self.process.net.request(
+                            self.process,
+                            Endpoint(addr, Token.STORAGE_WATCH_VALUE),
+                            req, timeout=None))
+                except FDBError as e:
+                    if e.name == "wrong_shard_server" and self.coordinators:
+                        self.locations.invalidate()
+                        continue
+                    raise
+            raise FDBError("wrong_shard_server",
+                           "location cache cannot converge")
         return self.loop.spawn(watch(), "watch")
 
     def _commit(self, req) -> Future:

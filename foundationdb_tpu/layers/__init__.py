@@ -1,0 +1,3 @@
+from foundationdb_tpu.layers import tuple as tuple_layer  # noqa: F401
+from foundationdb_tpu.layers.subspace import Subspace  # noqa: F401
+from foundationdb_tpu.layers.directory import DirectoryLayer  # noqa: F401

@@ -1,0 +1,2 @@
+from foundationdb_tpu.net.transport import (  # noqa: F401
+    NetProcess, NetTransport, RealEventLoop)

@@ -1,0 +1,79 @@
+"""Server process entry point: run roles over the real transport.
+
+Reference: fdbserver/fdbserver.actor.cpp main + worker.actor.cpp — one OS
+process hosts a set of roles listening on one address. The role spec comes in
+as JSON on argv (the stand-in for command-line flags + cluster file):
+
+  python -m foundationdb_tpu.net.server_main '{"listen": "127.0.0.1:4500",
+      "data_dir": "/tmp/x", "knobs": {"CONFLICT_BACKEND": "oracle"},
+      "roles": [{"role": "master", ...}, ...]}'
+
+Role args mirror the sim worker's InitRoleRequest args, with endpoint
+dictionaries {"address": ..., "token": ...} converted to Endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _to_endpoint(v):
+    from foundationdb_tpu.core.sim import Endpoint
+    if isinstance(v, dict) and set(v) == {"address", "token"}:
+        return Endpoint(v["address"], v["token"])
+    if isinstance(v, list):
+        return [_to_endpoint(x) for x in v]
+    return v
+
+
+def build_role(process, role: str, args: dict):
+    args = {k: _to_endpoint(v) for k, v in args.items()}
+    if role == "master":
+        from foundationdb_tpu.server.master import Master
+        return Master(process, **args)
+    if role == "proxy":
+        from foundationdb_tpu.server.proxy import Proxy, ResolverMap, ShardMap
+        args["resolvers"] = ResolverMap(
+            boundaries=[bytes.fromhex(b) for b in args["resolvers"]["boundaries"]],
+            endpoints=_to_endpoint(args["resolvers"]["endpoints"]))
+        args["shards"] = ShardMap(
+            boundaries=[bytes.fromhex(b) for b in args["shards"]["boundaries"]],
+            tags=args["shards"]["tags"])
+        return Proxy(process, **args)
+    if role == "resolver":
+        from foundationdb_tpu.server.resolver import Resolver
+        return Resolver(process, **args)
+    if role == "tlog":
+        from foundationdb_tpu.server.tlog import TLog
+        return TLog(process, **args)
+    if role == "storage":
+        from foundationdb_tpu.server.storage import StorageServer
+        return StorageServer(process, **args)
+    raise ValueError(f"unknown role {role!r}")
+
+
+def main(spec_json: str):
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.utils.knobs import KNOBS
+
+    spec = json.loads(spec_json)
+    for k, v in spec.get("knobs", {}).items():
+        KNOBS.set(k, v)
+    loop = RealEventLoop()
+    net = NetTransport(loop, spec["listen"],
+                       data_dir=spec.get("data_dir", "/tmp/fdbtpu"))
+    net.start()
+    roles = [build_role(net.process, r["role"], r.get("args", {}))
+             for r in spec["roles"]]
+    print(f"ready {spec['listen']} roles={[r['role'] for r in spec['roles']]}",
+          flush=True)
+    try:
+        loop.aio.run_forever()
+    finally:
+        net.close()
+        del roles
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -129,7 +129,8 @@ class RecoverableCluster:
 
     def __init__(self, seed: int = 0, n_coordinators: int = 3,
                  n_workers: int = 5, n_proxies: int = 2, n_resolvers: int = 1,
-                 n_tlogs: int = 2, n_storage: int = 2):
+                 n_tlogs: int = 2, n_storage: int = 2, n_replicas: int = 1,
+                 n_storage_workers: int | None = None):
         from foundationdb_tpu.server.clustercontroller import (
             ClusterConfig, ClusterController)
         from foundationdb_tpu.server.coordination import Coordinator, elect_leader
@@ -140,7 +141,10 @@ class RecoverableCluster:
         self.net = SimNetwork(self.loop, self.rng.fork())
         self.config = ClusterConfig(n_proxies=n_proxies,
                                     n_resolvers=n_resolvers,
-                                    n_tlogs=n_tlogs, n_storage=n_storage)
+                                    n_tlogs=n_tlogs, n_storage=n_storage,
+                                    n_replicas=n_replicas)
+        if n_storage_workers is None:
+            n_storage_workers = n_storage * n_replicas
 
         self.coord_procs = [self.net.new_process(f"coord:{i}")
                             for i in range(n_coordinators)]
@@ -159,7 +163,7 @@ class RecoverableCluster:
         self.worker_procs = [self.net.new_process(f"worker:{i}")
                              for i in range(n_workers)]
         self.storage_worker_procs = [self.net.new_process(f"storagew:{i}")
-                                     for i in range(n_storage)]
+                                     for i in range(n_storage_workers)]
 
         def start_worker(proc: SimProcess):
             proc.worker = Worker(proc, self.coordinators,

@@ -43,7 +43,8 @@ class ClusterConfig:
     n_proxies: int = 1
     n_resolvers: int = 1
     n_tlogs: int = 1
-    n_storage: int = 1
+    n_storage: int = 1  # number of SHARDS
+    n_replicas: int = 1  # storage team size per shard (replication factor)
 
 
 @dataclass
@@ -80,6 +81,7 @@ class ClusterController:
         self._attempt = 0
         process.register(Token.CC_REGISTER_WORKER, self._on_register)
         process.register(Token.CC_GET_DBINFO, self._on_get_dbinfo)
+        process.register(Token.CC_GET_STATUS, self._on_get_status)
 
     def _on_register(self, req: RegisterWorkerRequest, reply):
         self.registry.register(req, self.loop.now())
@@ -87,6 +89,54 @@ class ClusterController:
 
     def _on_get_dbinfo(self, req, reply):
         reply.send(self.dbinfo)
+
+    def _on_get_status(self, req, reply):
+        self.process.spawn(self._get_status(reply), "clusterGetStatus")
+
+    async def _get_status(self, reply):
+        """Status JSON assembled by the CC from every role
+        (fdbserver/Status.actor.cpp:1698 clusterGetStatus, schema shape from
+        fdbclient/Schemas.cpp — trimmed to what this cluster models)."""
+        info = self.dbinfo
+        now = self.loop.now()
+        status = {
+            "cluster": {
+                "recovery_state": {"name": info.recovery_state,
+                                   "epoch": info.epoch},
+                "generation": info.epoch,
+                "cluster_controller": self.process.address,
+                "coordinators": list(self.coordinators),
+                "workers": {
+                    a: {"roles": caps, "stale_seconds": round(now - seen, 2)}
+                    for a, (caps, seen) in sorted(self.registry.workers.items())
+                },
+                "layers": {"master": info.master,
+                           "proxies": list(info.proxies),
+                           "resolvers": list(info.resolvers),
+                           "ratekeeper": info.ratekeeper,
+                           "logs": [{"epoch": ep.epoch, "begin": ep.begin,
+                                     "end": ep.end, "addrs": list(ep.addrs)}
+                                    for ep in info.log_epochs],
+                           "storages": [{"address": a, "tag": t}
+                                        for a, t in info.storages]},
+                "data": {"shard_boundaries": [b.hex() for b in
+                                              info.shard_boundaries],
+                         "shard_teams": info.shard_tags},
+            },
+        }
+        # qos: live ratekeeper view (Status's qos section)
+        if info.ratekeeper:
+            try:
+                r = await self.loop.timeout(self.net.request(
+                    self.process, Endpoint(info.ratekeeper, Token.RK_GET_RATE),
+                    1), 1.0)
+                status["cluster"]["qos"] = {
+                    "transactions_per_second_limit": round(r.tps, 1)}
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                status["cluster"]["qos"] = {"unreachable": True}
+        reply.send(status)
 
     # -- leadership maintenance (tryBecomeLeader's nominee refresh) --
 
@@ -264,18 +314,36 @@ class ClusterController:
 
         if prior is None:
             storage_workers = self.registry.alive("storage", now)
-            if len(storage_workers) < cfg.n_storage:
+            # one storage role per worker (a process has one set of STORAGE_*
+            # endpoints, so co-located roles would displace each other —
+            # also the reference's normal deployment shape)
+            if len(storage_workers) < cfg.n_storage * cfg.n_replicas:
                 raise FDBError("recruitment_failed", "not enough storage workers")
+            # teams (DDTeamCollection :515): every shard gets n_replicas
+            # storage servers on DISTINCT workers, each with its OWN tag; the
+            # proxy routes each mutation to every team member's tag, so
+            # replication happens through the log, not server-to-server
             storages = []
+            shard_tags: list[list[int]] = []
             for i in range(cfg.n_storage):
                 srange = (boundaries[i],
                           boundaries[i + 1] if i + 1 < len(boundaries) else None)
-                addr = (await self._recruit_many(
-                    [storage_workers[i % len(storage_workers)]], 1, "storage",
-                    lambda _i, i=i, srange=srange: {
-                        "tag": i, "log_epochs": list(new_epochs),
-                        "recovery_count": epoch, "shard_ranges": [srange]}))[0]
-                storages.append((addr, i))
+                team = []
+                for r in range(cfg.n_replicas):
+                    tag = i * cfg.n_replicas + r
+                    w = storage_workers[tag % len(storage_workers)]
+                    addr = (await self._recruit_many(
+                        [w], 1, "storage",
+                        lambda _i, tag=tag, srange=srange: {
+                            "tag": tag, "log_epochs": list(new_epochs),
+                            "recovery_count": epoch,
+                            "shard_ranges": [srange]}))[0]
+                    storages.append((addr, tag))
+                    team.append(tag)
+                shard_tags.append(team)
+        else:
+            shard_tags = list(prior.get("shard_tags")
+                              or [[t] for _a, t in storages])
 
         # admission control alongside the new generation (Ratekeeper runs
         # with the master in the reference)
@@ -285,8 +353,7 @@ class ClusterController:
                        "storages": [a for a, _t in storages]}))[0]
 
         from foundationdb_tpu.server.proxy import ResolverMap, ShardMap
-        shard_map = ShardMap(boundaries=boundaries,
-                             tags=[[i] for i in range(cfg.n_storage)])
+        shard_map = ShardMap(boundaries=boundaries, tags=shard_tags)
         resolver_map = ResolverMap(
             boundaries=_partition_boundaries(cfg.n_resolvers),
             endpoints=[Endpoint(a, Token.RESOLVER_RESOLVE)
@@ -321,6 +388,7 @@ class ClusterController:
             "master": master_addr,
             "log_epochs": new_epochs,
             "storages": storages,
+            "shard_tags": shard_tags,
             "shard_boundaries": boundaries,
             "recovery_version": recovery_version,
         })
@@ -355,7 +423,7 @@ class ClusterController:
             proxies=proxy_addrs, resolvers=resolver_addrs,
             log_epochs=new_epochs, storages=storages,
             shard_boundaries=boundaries, recovery_state="accepting_commits",
-            ratekeeper=rk_addr)
+            ratekeeper=rk_addr, shard_tags=shard_tags)
         TraceEvent("CCRecovered", self.process.address) \
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()

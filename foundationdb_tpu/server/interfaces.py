@@ -46,6 +46,7 @@ class Token:
     CC_GET_DBINFO = 96
     CC_SET_DBINFO = 97
     CC_GET_WORKERS = 98
+    CC_GET_STATUS = 99
 
 
 # --- master ---
@@ -317,3 +318,13 @@ class DBInfo:
     shard_boundaries: list[bytes]
     recovery_state: str = "unrecovered"
     ratekeeper: str | None = None
+    # team per shard: the tags of the replicas serving shard i
+    # (DDTeamCollection's server teams, DataDistribution.actor.cpp:515)
+    shard_tags: list[list[int]] | None = None
+
+    def teams(self) -> list[list[int]]:
+        """shard -> replica tags, defaulting to the single-replica identity
+        layout — THE source of truth for every consumer (client location
+        cache, worker storage restore, consistency checker)."""
+        return self.shard_tags or [[i] for i in
+                                   range(len(self.shard_boundaries))]

@@ -144,13 +144,22 @@ class TLog:
         floor = self._mem_floor.get(tag, 0)
         if req.begin < floor:
             # spilled range: serve from the durable queue (the disk read the
-            # reference does for spilled tags); entries are seq-ordered
-            for _seq, payload in self.queue.live_entries:
+            # reference does for spilled tags). _version_seq maps versions to
+            # queue sequence numbers, so the scan starts AT req.begin instead
+            # of deserializing the whole queue per page (which would make
+            # catch-up quadratic in backlog size).
+            start_seq = next((seq for v, seq in self._version_seq
+                              if v >= req.begin), 1 << 62)
+            for seq, payload in self.queue.live_entries:
+                if seq < start_seq:
+                    continue
                 obj = pickle.loads(payload)
                 if isinstance(obj, dict):
                     continue  # lock marker
                 version, messages = obj
-                if version < req.begin or version >= floor:
+                if version >= floor:
+                    break  # seq order == version order: rest is in memory
+                if version < req.begin:
                     continue
                 muts = messages.get(tag)
                 if muts:

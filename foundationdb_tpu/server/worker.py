@@ -120,16 +120,23 @@ class Worker:
                     if info.recovery_state == "accepting_commits":
                         from foundationdb_tpu.server.storage import StorageServer
                         b = info.shard_boundaries
+                        shard_tags = info.teams()
                         for tag in tags:
                             key = f"storage:{tag}"
-                            if key not in self.roles:
-                                srange = (b[tag], b[tag + 1]
-                                          if tag + 1 < len(b) else None)
-                                self.roles[key] = StorageServer(
-                                    self.process, tag=tag,
-                                    log_epochs=list(info.log_epochs),
-                                    recovery_count=info.epoch,
-                                    shard_ranges=[srange])
+                            if key in self.roles:
+                                continue
+                            shard = next((i for i, team in
+                                          enumerate(shard_tags)
+                                          if tag in team), None)
+                            if shard is None:
+                                continue  # tag no longer in the layout
+                            srange = (b[shard], b[shard + 1]
+                                      if shard + 1 < len(b) else None)
+                            self.roles[key] = StorageServer(
+                                self.process, tag=tag,
+                                log_epochs=list(info.log_epochs),
+                                recovery_count=info.epoch,
+                                shard_ranges=[srange])
                         return
             except FDBError:
                 pass

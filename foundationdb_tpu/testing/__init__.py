@@ -1,3 +1,3 @@
 from foundationdb_tpu.testing.workloads import (  # noqa: F401
-    AttritionWorkload, CycleWorkload, RandomCloggingWorkload,
-    SwizzleCloggingWorkload, run_spec)
+    AttritionWorkload, ConsistencyCheckWorkload, CycleWorkload,
+    RandomCloggingWorkload, SwizzleCloggingWorkload, run_spec)

@@ -492,8 +492,8 @@ def test_wrong_shard_server_rejected():
             tr.set(b"\x10a", b"1")
             tr.set(b"\xf0b", b"2")
         await db.transact(setup)
-        # corrupt the location cache: swap the two shard owners
-        db.locations.addrs = db.locations.addrs[::-1]
+        # corrupt the location cache: swap the two shard teams
+        db.locations.teams = db.locations.teams[::-1]
         tr = db.create_transaction()
         try:
             await tr.get(b"\x10a")

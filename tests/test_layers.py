@@ -1,0 +1,212 @@
+"""Tuple/subspace/directory layers, status JSON, fdbcli, counters.
+
+Reference: bindings/python/fdb/tuple.py + design/tuple.md (order-preserving
+tuple format), subspace_impl.py, directory_impl.py,
+fdbserver/Status.actor.cpp clusterGetStatus, fdbcli/fdbcli.actor.cpp,
+flow/Stats.h Counter/CounterCollection.
+"""
+
+import pytest
+
+from foundationdb_tpu.layers import tuple as T
+from foundationdb_tpu.layers.directory import DirectoryLayer
+from foundationdb_tpu.layers.subspace import Subspace
+from foundationdb_tpu.server.cluster import RecoverableCluster, SimCluster
+from foundationdb_tpu.tools.fdbcli import FdbCli
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+from foundationdb_tpu.utils.stats import Counter, CounterCollection
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+# -- tuple layer --
+
+def test_tuple_roundtrip():
+    cases = [
+        (),
+        (None,),
+        (b"bytes", "string", 0, 1, -1, 255, -255, 65536, -65536,
+         (1 << 60), -(1 << 60)),
+        (3.14, -3.14, 0.0, float("inf"), float("-inf")),
+        (True, False),
+        (("nested", (None, b"\x00deep\x00")), "after"),
+        (b"\x00\x01\xff", "uniécode"),
+    ]
+    for t in cases:
+        assert T.unpack(T.pack(t)) == t, t
+
+
+def test_tuple_order_preserving():
+    """Packed byte order must equal logical element order — the property
+    every layer's range scans rest on."""
+    rng = DeterministicRandom(5)
+
+    def rand_elem(depth=0):
+        k = rng.randint(0, 5 if depth else 6)
+        if k == 0:
+            return None
+        if k == 1:
+            return rng.random_bytes(rng.randint(0, 6))
+        if k == 2:
+            return "".join(chr(97 + rng.randint(0, 25))
+                           for _ in range(rng.randint(0, 5)))
+        if k == 3:
+            return rng.randint(-(1 << 40), 1 << 40)
+        if k == 4:
+            return rng.random() * 2000 - 1000
+        if k == 5:
+            return bool(rng.coinflip())
+        return tuple(rand_elem(depth + 1) for _ in range(rng.randint(0, 3)))
+
+    def type_rank(e):
+        # the format's cross-type order (by type code): null(0x00) <
+        # bytes(0x01) < str(0x02) < nested(0x05) < int(0x0c..) <
+        # double(0x21) < false(0x26) < true(0x27)
+        if e is None:
+            return 0
+        if isinstance(e, bytes):
+            return 1
+        if isinstance(e, str):
+            return 2
+        if isinstance(e, tuple):
+            return 3
+        if isinstance(e, bool):
+            return 6
+        if isinstance(e, int):
+            return 4
+        return 5
+
+    def logical_key(t):
+        return tuple((type_rank(e),
+                      logical_key(e) if isinstance(e, tuple)
+                      else (e if not isinstance(e, bool) else int(e)))
+                     for e in t)
+
+    tuples = [tuple(rand_elem() for _ in range(rng.randint(0, 3)))
+              for _ in range(300)]
+    by_packed = sorted(tuples, key=lambda t: T.pack(t))
+    by_logic = sorted(tuples, key=logical_key)
+    assert [T.pack(t) for t in by_packed] == [T.pack(t) for t in by_logic]
+
+
+def test_tuple_range():
+    lo, hi = T.range_of(("users",))
+    assert lo < T.pack(("users", 1)) < hi
+    assert lo < T.pack(("users", "zz", "deep")) < hi
+    assert not (lo < T.pack(("userz",)) < hi)
+
+
+# -- subspace --
+
+def test_subspace():
+    users = Subspace(("app", "users"))
+    k = users.pack((42, "bob"))
+    assert users.contains(k)
+    assert users.unpack(k) == (42, "bob")
+    sub = users[42]
+    assert sub.contains(users.pack((42, "x")))
+    lo, hi = users.range()
+    assert lo < k < hi
+    with pytest.raises(ValueError):
+        users.unpack(b"not-in-subspace")
+
+
+# -- directory --
+
+def test_directory_layer():
+    c = SimCluster(seed=9)
+    db = c.database()
+    dl = DirectoryLayer()
+
+    async def t():
+        async def mk(tr):
+            d = await dl.create_or_open(tr, ("app", "events"))
+            tr.set(d.pack((1,)), b"first")
+            return d
+        d = await db.transact(mk)
+
+        async def reopen(tr):
+            return await dl.create_or_open(tr, ("app", "events"))
+        d2 = await db.transact(reopen)
+        assert d2.key == d.key, "reopen must return the same prefix"
+
+        async def read(tr):
+            return await tr.get(d.pack((1,)))
+        assert await db.transact(read) == b"first"
+
+        async def other(tr):
+            return await dl.create_or_open(tr, ("app", "users"))
+        d3 = await db.transact(other)
+        assert d3.key != d.key
+
+        async def ls(tr):
+            return await dl.list(tr, ("app",))
+        assert sorted(await db.transact(ls)) == ["events", "users"]
+
+        async def rm(tr):
+            return await dl.remove(tr, ("app", "events"))
+        assert await db.transact(rm)
+        async def gone(tr):
+            return (await dl.open(tr, ("app", "events")),
+                    await tr.get(d.pack((1,))))
+        node, val = await db.transact(gone)
+        assert node is None and val is None
+
+    c.run(c.loop.spawn(t()), max_time=10_000.0)
+
+
+# -- counters --
+
+def test_counters():
+    cc = CounterCollection("ProxyStats", "proxy:0")
+    commits = cc.counter("Commits")
+    commits += 5
+    conflicts = Counter("Conflicts", cc)
+    conflicts.increment(2)
+    assert cc.as_dict() == {"Commits": 5, "Conflicts": 2}
+    cc.trace(now=10.0)
+    commits += 5
+    cc.trace(now=12.0)  # rate = 5/2
+    assert commits.rate_since_dump(2.0) == 0.0  # just dumped
+
+
+# -- status + fdbcli --
+
+def test_status_and_fdbcli():
+    c = RecoverableCluster(seed=77, n_workers=4, n_proxies=2, n_tlogs=2,
+                           n_storage=2)
+    db = c.database()
+
+    async def boot():
+        await db.refresh()
+    c.run(c.loop.spawn(boot()), max_time=60_000.0)
+
+    cli = FdbCli(c, db)
+    assert any("ERROR: writemode" in line for line in cli.execute("set a 1"))
+    cli.execute("writemode on")
+    assert cli.execute("set hello world") == ["Committed"]
+    assert cli.execute("get hello") == ["`hello' is `world'"]
+    cli.execute("set hellp x")
+    out = cli.execute("getrange hell hellz 10")
+    assert "`hello' is `world'" in out[1]
+    assert any("hellp" in line for line in out)
+    cli.execute("clear hellp")
+    assert cli.execute("get hellp") == ["`hellp': not found"]
+
+    out = cli.execute("status")
+    assert any("accepting_commits" in line for line in out)
+    assert any("Storage servers - 2" in line for line in out)
+
+    async def status_json():
+        return await db.get_status()
+    status = c.run(c.loop.spawn(status_json()), max_time=60_000.0)
+    cl = status["cluster"]
+    assert cl["recovery_state"]["name"] == "accepting_commits"
+    assert len(cl["layers"]["proxies"]) == 2
+    assert len(cl["layers"]["storages"]) == 2
+    assert "transactions_per_second_limit" in cl["qos"]
