@@ -50,8 +50,11 @@ class DirectoryLayer:
         existing = await tr.get(node_key)
         if existing is not None:
             return existing
-        # allocate the next short prefix (atomic add keeps the hot counter
-        # conflict-free; the read below is in a separate retry-safe txn flow)
+        # allocate the next short prefix. NOTE: reading the counter in the
+        # same transaction adds a read conflict on it, so concurrent
+        # directory creations serialize through retries — the contention the
+        # reference's high-contention allocator avoids; an HCA analogue can
+        # slot in here without changing the directory API
         tr.atomic_op(MutationType.ADD_VALUE, self._alloc_key,
                      struct.pack("<q", 1))
         raw = await tr.get(self._alloc_key)

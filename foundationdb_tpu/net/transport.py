@@ -219,7 +219,7 @@ class NetTransport:
             timeout = KNOBS.SIM_RPC_TIMEOUT_SECONDS
         reply_id = self._next_reply_id
         self._next_reply_id += 1
-        self._pending[reply_id] = reply
+        self._pending[reply_id] = (reply, dest.address)
 
         async def send():
             try:
@@ -229,16 +229,17 @@ class NetTransport:
                 await w.drain()
             except OSError:
                 self._peers.pop(dest.address, None)
-                p = self._pending.pop(reply_id, None)
-                if p is not None and not p.is_set():
-                    p.send_error(FDBError("broken_promise", "connect failed"))
+                entry = self._pending.pop(reply_id, None)
+                if entry is not None and not entry[0].is_set():
+                    entry[0].send_error(FDBError("broken_promise",
+                                                 "connect failed"))
 
         self.loop.aio.create_task(send())
         if timeout is not None:
             def expire():
-                p = self._pending.pop(reply_id, None)
-                if p is not None and not p.is_set():
-                    p.send_error(FDBError("request_maybe_delivered"))
+                entry = self._pending.pop(reply_id, None)
+                if entry is not None and not entry[0].is_set():
+                    entry[0].send_error(FDBError("request_maybe_delivered"))
             self.loop.aio.call_later(timeout, expire)
         return reply.future
 
@@ -272,7 +273,14 @@ class NetTransport:
                 return
             while True:
                 token, reply_id, kind, payload = await self._read_frame(reader)
-                self._dispatch(token, reply_id, kind, payload, writer)
+                try:
+                    self._dispatch(token, reply_id, kind, payload, writer)
+                except Exception:  # noqa: BLE001 — a bad handler/payload
+                    # must not kill the connection's read loop (every later
+                    # packet from this peer would silently hang otherwise)
+                    if kind == _REQUEST:
+                        writer.write(self._frame(0, reply_id, _REPLY_ERROR,
+                                                 pickle.dumps("unknown_error")))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return
 
@@ -304,14 +312,23 @@ class NetTransport:
         try:
             while True:
                 _token, reply_id, kind, payload = await self._read_frame(reader)
-                p = self._pending.pop(reply_id, None)
-                if p is None or p.is_set():
+                entry = self._pending.pop(reply_id, None)
+                if entry is None or entry[0].is_set():
                     continue
                 if kind == _REPLY:
-                    p.send(payload)
+                    entry[0].send(payload)
                 elif kind == _REPLY_ERROR:
-                    p.send_error(FDBError(payload) if isinstance(payload, str)
-                                 else FDBError("unknown_error"))
+                    entry[0].send_error(
+                        FDBError(payload) if isinstance(payload, str)
+                        else FDBError("unknown_error"))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            # fail every in-flight request on this connection NOW (the peer-
+            # failure path of FlowTransport): waiting out the RPC timeout
+            # stalls failover, and timeout=None waiters would leak forever
             self._peers.pop(address, None)
+            for rid in [r for r, (_p, a) in self._pending.items()
+                        if a == address]:
+                p, _a = self._pending.pop(rid)
+                if not p.is_set():
+                    p.send_error(FDBError("broken_promise", "peer closed"))
             return

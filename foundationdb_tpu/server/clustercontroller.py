@@ -30,8 +30,9 @@ from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.coordination import (
     CandidacyRequest, CoordinatedStateClient, CoordToken, quorum_wait)
 from foundationdb_tpu.server.interfaces import (
-    DBInfo, InitRoleRequest, LogEpoch, RegisterWorkerRequest,
-    SetLogSystemRequest, TLogLockRequest, Token)
+    AddShardRequest, DBInfo, GetStorageMetricsRequest, InitRoleRequest,
+    LogEpoch, RegisterWorkerRequest, SetLogSystemRequest, SetShardsRequest,
+    TLogLockRequest, Token, UpdateShardsRequest)
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.keys import partition_boundaries as _partition_boundaries
 from foundationdb_tpu.utils.knobs import KNOBS
@@ -428,6 +429,11 @@ class ClusterController:
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()
 
+        # shard tracker / relocator (DataDistribution.actor.cpp:2260 runs
+        # alongside the master; here it runs with the CC and survives until
+        # the next recovery replaces it)
+        self._watchers.append(
+            self.process.spawn(self._data_distribution(), "dataDistribution"))
         # babysit the new generation (role stomps by racing recoveries,
         # self-deposed masters, and self-killed proxies are caught by the
         # epoch watchers; worker deaths by the incarnation pings)
@@ -506,3 +512,131 @@ class ClusterController:
                 raise FDBError("recruitment_failed",
                                f"{role} on {addr}: {e.name}") from None
         return addrs
+
+    # -- data distribution (shard tracker + relocator) --
+
+    async def _data_distribution(self):
+        """shardSplitter (DataDistributionTracker.actor.cpp:314) + a
+        least-loaded relocation policy (DataDistributionQueue :849) +
+        MoveKeys-style execution: split an oversized shard at its sampled
+        median and hand the upper half to the team currently serving the
+        fewest shards. Every step is fenced so no mutation is lost:
+          1. swap every proxy's shard map (dual-routes the moving range)
+          2. take a version fence from the master (all later commits use
+             the new routing)
+          3. destination team fetches the range (storage _add_shard)
+          4. publish the new layout (cstate + DBInfo); source drops the range
+        """
+        while True:
+            await self.loop.delay(KNOBS.DD_INTERVAL_SECONDS)
+            info = self.dbinfo
+            if self.deposed or info.recovery_state != "accepting_commits":
+                continue
+            try:
+                await self._dd_once()
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                TraceEvent("DDRoundFailed", self.process.address) \
+                    .detail("Error", e.name).log()
+
+    async def _dd_once(self):
+        info = self.dbinfo
+        b = list(info.shard_boundaries)
+        teams = [list(t) for t in info.teams()]
+        addr_of_tag = {t: a for a, t in info.storages}
+        # sample every shard from one replica
+        for i, team in enumerate(teams):
+            lo = b[i]
+            hi = b[i + 1] if i + 1 < len(b) else None
+            owner = addr_of_tag[team[0]]
+            metrics = await self.loop.timeout(self.net.request(
+                self.process, Endpoint(owner, Token.STORAGE_GET_METRICS),
+                GetStorageMetricsRequest(ranges=[(lo, hi)])), 2.0)
+            m = metrics[0]
+            if m.bytes <= KNOBS.DD_SHARD_SPLIT_BYTES or m.split_key is None:
+                continue
+            await self._split_and_move(i, m.split_key)
+            return  # one relocation per round
+
+    async def _split_and_move(self, i: int, split_key: bytes):
+        info = self.dbinfo
+        b = list(info.shard_boundaries)
+        teams = [list(t) for t in info.teams()]
+        addr_of_tag = {t: a for a, t in info.storages}
+        old_team = teams[i]
+        hi = b[i + 1] if i + 1 < len(b) else None
+        # destination: the team serving the fewest shards (itself included:
+        # a pure split happens when the source team is least loaded)
+        uniq: list[list[int]] = []
+        for t in teams:
+            if t not in uniq:
+                uniq.append(t)
+        counts = {tuple(t): sum(1 for x in teams if x == t) for t in uniq}
+        dest = min(uniq, key=lambda t: (counts[tuple(t)], tuple(t)))
+        new_b = b[:i + 1] + [split_key] + b[i + 1:]
+        new_teams = teams[:i + 1] + [dest] + teams[i + 1:]
+        # during the handoff the moving range is DUAL-ROUTED to source and
+        # destination tags: the source keeps serving (and seeing) acked
+        # writes until the layout is published, and a CC crash mid-move
+        # leaves the old cstate layout fully correct (the source missed
+        # nothing; the destination's partial copy is simply never served)
+        both = sorted(set(old_team) | set(dest))
+        interim_teams = teams[:i + 1] + [both] + teams[i + 1:]
+        TraceEvent("DDSplitShard", self.process.address) \
+            .detail("At", split_key.hex()).detail("Move", dest != old_team).log()
+
+        # 1. dual-route: every proxy swaps its map (awaited: the fence below
+        # is only meaningful once no proxy still routes with the old map)
+        for pa in info.proxies:
+            await self.loop.timeout(self.net.request(
+                self.process, Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
+                UpdateShardsRequest(boundaries=new_b, tags=interim_teams)),
+                2.0)
+        # 2. read-only version fence: every batch still carrying the old
+        # routing was allocated its version BEFORE this read (allocation
+        # precedes routing within a batch), so all its mutations are <= fence
+        # and the snapshot fetched at >= fence includes them
+        fence = await self.loop.timeout(self.net.request(
+            self.process,
+            Endpoint(info.master, Token.MASTER_GET_CURRENT_VERSION), None),
+            2.0)
+        # 3. destination fetches (no-op when the team keeps the shard)
+        if dest != old_team:
+            src = addr_of_tag[old_team[0]]
+            for tag in dest:
+                await self.loop.timeout(self.net.request(
+                    self.process,
+                    Endpoint(addr_of_tag[tag], Token.STORAGE_ADD_SHARD),
+                    AddShardRequest(begin=split_key, end=hi, source=src,
+                                    fence_version=fence)), 30.0)
+        # 4. publish: cstate first (a concurrent recovery must see the new
+        # layout), then DBInfo for clients; finally shrink the source
+        prior, _gen = await self.cstate.read()
+        if prior is None or prior.get("epoch") != info.epoch or self.deposed:
+            raise FDBError("coordinators_changed", "layout changed under DD")
+        prior["shard_boundaries"] = new_b
+        prior["shard_tags"] = new_teams
+        await self.cstate.write(prior)
+        self.dbinfo = DBInfo(
+            version=info.version + 1, epoch=info.epoch, master=info.master,
+            proxies=info.proxies, resolvers=info.resolvers,
+            log_epochs=info.log_epochs, storages=info.storages,
+            shard_boundaries=new_b, recovery_state="accepting_commits",
+            ratekeeper=info.ratekeeper, shard_tags=new_teams)
+        # 5. end the dual-route window: final single-team routing, then the
+        # source stops serving the moved range (stale clients get
+        # wrong_shard_server and re-resolve through the published layout)
+        for pa in info.proxies:
+            self.net.one_way(self.process,
+                             Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
+                             UpdateShardsRequest(boundaries=new_b,
+                                                 tags=new_teams))
+        if dest != old_team:
+            keep = [(new_b[j], new_b[j + 1] if j + 1 < len(new_b) else None)
+                    for j, t in enumerate(new_teams) if t == old_team]
+            for tag in old_team:
+                self.net.one_way(self.process,
+                                 Endpoint(addr_of_tag[tag],
+                                          Token.STORAGE_SET_SHARDS),
+                                 SetShardsRequest(shard_ranges=keep))

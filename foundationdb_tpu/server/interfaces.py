@@ -23,6 +23,7 @@ class Token:
     MASTER_GET_COMMIT_VERSION = 1
     MASTER_PING = 2
     MASTER_DEPOSE = 3
+    MASTER_GET_CURRENT_VERSION = 4
     PROXY_COMMIT = 10
     PROXY_GET_READ_VERSION = 11
     PROXY_GET_KEY_LOCATIONS = 12
@@ -38,6 +39,10 @@ class Token:
     STORAGE_GET_SHARD_STATE = 43
     TLOG_LOCK = 33
     STORAGE_SET_LOGSYSTEM = 44
+    STORAGE_GET_METRICS = 45
+    STORAGE_ADD_SHARD = 46
+    STORAGE_SET_SHARDS = 47
+    PROXY_UPDATE_SHARDS = 15
     RK_GET_RATE = 80
     QUEUE_STATS = 81
     WORKER_PING = 90
@@ -280,6 +285,50 @@ class SetLogSystemRequest:
     epochs: list  # list[LogEpoch]
     rollback_to: int
     recovery_count: int
+
+
+@dataclass
+class GetStorageMetricsRequest:
+    """StorageMetrics sampling (fdbserver/StorageMetrics.actor.h): byte
+    counts + a split-point candidate per queried range, for the data
+    distributor's shard tracker."""
+
+    ranges: list  # list[(begin, end|None)]
+
+
+@dataclass
+class ShardMetrics:
+    bytes: int
+    split_key: bytes | None  # median key, None if too few rows
+
+
+@dataclass
+class AddShardRequest:
+    """MoveKeys destination half (fetchKeys, storageserver.actor.cpp:1775):
+    pause ingestion, snapshot [begin, end) from `source` at the current
+    applied version, splice it in, extend the served ranges, resume. The
+    fence version proves every mutation after it is dual-routed to this
+    server's tag."""
+
+    begin: bytes
+    end: bytes | None
+    source: str  # storage address to fetch the snapshot from
+    fence_version: int
+
+
+@dataclass
+class SetShardsRequest:
+    """Replace the served ranges (MoveKeys source side after the handoff)."""
+
+    shard_ranges: list  # list[(begin, end|None)]
+
+
+@dataclass
+class UpdateShardsRequest:
+    """Proxy shard-map swap (the applyMetadataMutations keyServers update)."""
+
+    boundaries: list
+    tags: list  # list[list[int]]
 
 
 @dataclass

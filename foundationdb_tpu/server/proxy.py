@@ -135,6 +135,7 @@ class Proxy:
         process.register(Token.PROXY_GET_COMMITTED_VERSION,
                          self._on_get_committed_version)
         process.register(Token.PROXY_PING, self._on_proxy_ping)
+        process.register(Token.PROXY_UPDATE_SHARDS, self._on_update_shards)
         self._lease_task = process.spawn(self._master_lease_loop(), "masterLease")
         self._last_flush = self.loop.now()
         # idle empty batches (the reference's MAX_COMMIT_BATCH_INTERVAL
@@ -180,6 +181,16 @@ class Proxy:
 
     def _on_proxy_ping(self, req, reply):
         reply.send(self.epoch)
+
+    def _on_update_shards(self, req, reply):
+        """Shard-map swap from the data distributor (the reference's
+        applyMetadataMutations keyInfo update). Mutation routing reads
+        self.shards at phase 3, so every batch not yet routed — including
+        in-flight ones — uses the new map from this instant on; the
+        distributor takes its version fence AFTER this ack."""
+        self.shards = ShardMap(boundaries=list(req.boundaries),
+                               tags=[list(t) for t in req.tags])
+        reply.send(None)
 
     def die(self, reason: str):
         """The reference's commit-path contract: a proxy whose pipeline keeps

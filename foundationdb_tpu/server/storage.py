@@ -19,8 +19,9 @@ from collections import deque
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
-    GetKeyValuesReply, GetKeyValuesRequest, GetValueReply, GetValueRequest,
-    KeySelector, LogEpoch, SetLogSystemRequest, TLogPeekRequest,
+    AddShardRequest, GetKeyValuesReply, GetKeyValuesRequest, GetValueReply,
+    GetValueRequest, GetStorageMetricsRequest, KeySelector, LogEpoch,
+    SetLogSystemRequest, SetShardsRequest, ShardMetrics, TLogPeekRequest,
     TLogPopRequest, Token, WatchValueRequest)
 from foundationdb_tpu.server.versioned_map import VersionedMap
 from foundationdb_tpu.storage.kvstore import MemoryKeyValueStore
@@ -84,6 +85,10 @@ class StorageServer:
         process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
         process.register(Token.STORAGE_SET_LOGSYSTEM, self._on_set_logsystem)
         process.register(Token.QUEUE_STATS, self._on_queue_stats)
+        process.register(Token.STORAGE_GET_METRICS, self._on_get_metrics)
+        process.register(Token.STORAGE_ADD_SHARD, self._on_add_shard)
+        process.register(Token.STORAGE_SET_SHARDS, self._on_set_shards)
+        self._ingest_gate: object | None = None  # set while fetchKeys runs
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
 
     def shutdown(self):
@@ -120,11 +125,102 @@ class StorageServer:
                 return ep
         return self.log_epochs[-1]
 
+    # -- data distribution (metrics + fetchKeys) --
+
+    def _on_get_metrics(self, req: GetStorageMetricsRequest, reply):
+        """Byte counts + split candidate per range (the byte-sampling feed
+        for shardSplitter, storageserver byteSampleApplySet :2992 — here an
+        exact count over the durable engine, affordable at sim scale)."""
+        out = []
+        for b, e in req.ranges:
+            rows = self.store.get_range(b, e if e is not None else b"\xff" * 40)
+            total = sum(len(k) + len(v) for k, v in rows)
+            split = rows[len(rows) // 2][0] if len(rows) >= 4 else None
+            if split == b:
+                split = None  # a split at the begin boundary is no split
+            out.append(ShardMetrics(bytes=total, split_key=split))
+        reply.send(out)
+
+    def _on_set_shards(self, req: SetShardsRequest, reply):
+        self.shard_ranges = [tuple(r) for r in req.shard_ranges]
+        reply.send(None)
+
+    def _on_add_shard(self, req: AddShardRequest, reply):
+        self.process.spawn(self._add_shard(req, reply), "fetchKeys")
+
+    async def _add_shard(self, req: AddShardRequest, reply):
+        """fetchKeys (:1775), simplified to a stop-the-world splice:
+
+        By the fence, every mutation with version > fence is also routed to
+        this server's tag, so: pause ingestion at applied version C0 >= the
+        point where this request could arrive, snapshot [begin, end) at C0
+        from the source (which keeps receiving the range's mutations until
+        the handoff completes), replace the range's contents at C0, extend
+        the served ranges, resume. Mutations in (fence, C0] that were already
+        applied from the log are subsumed by the snapshot (the source applied
+        them too); mutations > C0 arrive through the log as usual. The
+        reference fetches concurrently with buffered mutations (AddingShard)
+        instead of pausing — an optimization, not a correctness difference.
+        """
+        from foundationdb_tpu.core.future import Future
+        if (req.begin, req.end) in (self.shard_ranges or []):
+            reply.send(self.version.get())  # duplicate/retried move: done
+            return
+        if self._ingest_gate is not None:
+            # one splice at a time: a second concurrent fetch would clobber
+            # the ingestion gate and apply its snapshot below already-applied
+            # versions. The distributor just retries next round.
+            reply.send_error(FDBError("operation_failed",
+                                      "fetchKeys already in progress"))
+            return
+        # catch up to the fence FIRST (ingestion must still be running):
+        # mutations at versions <= fence may have been routed only to the
+        # old team, so a snapshot below the fence would miss them here
+        await self.version.when_at_least(req.fence_version)
+        gate = Future()
+        self._ingest_gate = gate
+        try:
+            c0 = self.version.get()
+            end = req.end if req.end is not None else b"\xff" * 40
+            rows: list[tuple[bytes, bytes]] = []
+            cursor = req.begin
+            while True:
+                r = await self.process.net.request(
+                    self.process, Endpoint(req.source, Token.STORAGE_GET_KEY_VALUES),
+                    GetKeyValuesRequest(
+                        begin=KeySelector.first_greater_or_equal(cursor),
+                        end=KeySelector.first_greater_or_equal(end),
+                        version=c0))
+                rows.extend(r.data)
+                if not (r.more and r.data):
+                    break
+                cursor = r.data[-1][0] + b"\x00"
+            # splice: exact range state at C0 (clear first: a key this
+            # server saw via the log but the source has since cleared must
+            # not survive). Durability goes through _pending_durable so the
+            # engine applies it IN VERSION ORDER relative to everything
+            # already queued below C0.
+            muts = [Mutation(MutationType.CLEAR_RANGE, req.begin, end)]
+            muts += [Mutation(MutationType.SET_VALUE, k, v) for k, v in rows]
+            for m in muts:
+                self.data.apply(c0, m)
+            self._pending_durable.append((c0, muts))
+            self.shard_ranges = (self.shard_ranges or []) + [(req.begin,
+                                                              req.end)]
+            reply.send(c0)
+        except FDBError as e:
+            reply.send_error(e)
+        finally:
+            self._ingest_gate = None
+            gate._set(None)
+
     # -- ingestion (update :2358 + updateStorage :2633) --
 
     async def _update_loop(self):
         loop = self.process.net.loop
         while True:
+            if self._ingest_gate is not None:
+                await self._ingest_gate  # fetchKeys splice in progress
             epoch = self._epoch_for(self._peek_begin + 1)
             idx = self._peek_rotation % len(epoch.addrs)
             addr = epoch.addrs[idx]

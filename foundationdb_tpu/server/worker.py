@@ -125,18 +125,19 @@ class Worker:
                             key = f"storage:{tag}"
                             if key in self.roles:
                                 continue
-                            shard = next((i for i, team in
-                                          enumerate(shard_tags)
-                                          if tag in team), None)
-                            if shard is None:
+                            # EVERY shard whose team includes this tag (a
+                            # team can serve several shards after DD moves)
+                            sranges = [
+                                (b[i], b[i + 1] if i + 1 < len(b) else None)
+                                for i, team in enumerate(shard_tags)
+                                if tag in team]
+                            if not sranges:
                                 continue  # tag no longer in the layout
-                            srange = (b[shard], b[shard + 1]
-                                      if shard + 1 < len(b) else None)
                             self.roles[key] = StorageServer(
                                 self.process, tag=tag,
                                 log_epochs=list(info.log_epochs),
                                 recovery_count=info.epoch,
-                                shard_ranges=[srange])
+                                shard_ranges=sranges)
                         return
             except FDBError:
                 pass

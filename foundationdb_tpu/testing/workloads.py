@@ -303,9 +303,9 @@ class ConsistencyCheckWorkload(Workload):
             # out mid-check) retry the whole shard at a FRESH version — only
             # a clean same-version comparison may vote
             for attempt in range(60):
-                tr = db.create_transaction()
-                version = await tr.get_read_version()
                 try:
+                    tr = db.create_transaction()
+                    version = await tr.get_read_version()
                     per_replica = [(tag, await read_replica(tag, lo, hi,
                                                             version))
                                    for tag in team]

@@ -42,7 +42,14 @@ class FdbCli:
             self._print(f"ERROR: unknown command `{cmd}'. Try `help'.")
             return self.out
         task = self.cluster.loop.spawn(handler(args), name=f"fdbcli/{cmd}")
-        self.cluster.run(task, max_time=self.cluster.loop.now() + 600.0)
+        try:
+            self.cluster.run(task, max_time=self.cluster.loop.now() + 600.0)
+        except SystemExit:
+            raise
+        except IndexError:
+            self._print(f"ERROR: `{cmd}' is missing arguments. Try `help'.")
+        except Exception as e:  # noqa: BLE001 — the shell must survive
+            self._print(f"ERROR: {getattr(e, 'name', type(e).__name__)}")
         return self.out
 
     # -- commands (initHelp :430-518 surface) --

@@ -110,6 +110,10 @@ KNOBS.init("RK_TARGET_STORAGE_LAG_VERSIONS", 10_000_000)  # worst durability lag
 KNOBS.init("RK_TARGET_TLOG_BYTES", 2_000_000, (200_000,))  # worst log queue
 KNOBS.init("RK_BASE_TPS", 100_000.0)  # unthrottled budget
 KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
+
+# --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
+KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
+KNOBS.init("DD_SHARD_SPLIT_BYTES", 500_000, (5_000,))  # shardSplitter :314 threshold
 KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)
 KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
 
