@@ -4,7 +4,7 @@ Mirrors the reference's in-binary microbench skipListTest()
 (fdbserver/SkipList.cpp:1412-1502): batches of transactions each carrying one
 read range and one write range over a 20M-key keyspace (span 1-10, the
 reference's randomInt(0,20000000) / key+1+randomInt(0,10) shape), processed in
-commit order with a history window holding ~15 batches (~123k txns — the
+commit order with a history window holding ~8 batches (~131k txns — the
 reference's window is 50 batches x 2500 txns = 125k). The metric is
 transactions per second through the conflict engine.
 
@@ -35,14 +35,14 @@ import numpy as np
 
 BASELINE_TXNS_PER_SEC = 1.0e6
 
-TXNS_PER_BATCH = 8192
-N_BATCHES = 300
+TXNS_PER_BATCH = 16384
+N_BATCHES = 200
 CHUNK = 100  # batches per conflict_scan dispatch (fixed shape: compile once)
 KEYSPACE = 20_000_000  # reference: randomInt(0, 20000000)
 MAX_SPAN = 10  # reference: key + 1 + randomInt(0, 10)
 CAPACITY = 1 << 18
 WINDOW = 5_000_000  # MAX_WRITE_TRANSACTION_LIFE_VERSIONS (Knobs.cpp:30-34)
-VERSION_STEP = WINDOW // 15  # ~15 batches (~123k txns) of history in the window
+VERSION_STEP = WINDOW // 8  # ~8 batches (~131k txns) of history in the window
 
 
 def _encode_batches(n_batches: int, seed: int, version0: int):
