@@ -546,6 +546,7 @@ class ClusterController:
         teams = [list(t) for t in info.teams()]
         addr_of_tag = {t: a for a, t in info.storages}
         # sample every shard from one replica
+        sizes: list[int] = []
         for i, team in enumerate(teams):
             lo = b[i]
             hi = b[i + 1] if i + 1 < len(b) else None
@@ -554,10 +555,73 @@ class ClusterController:
                 self.process, Endpoint(owner, Token.STORAGE_GET_METRICS),
                 GetStorageMetricsRequest(ranges=[(lo, hi)])), 2.0)
             m = metrics[0]
+            sizes.append(m.bytes)
             if m.bytes <= KNOBS.DD_SHARD_SPLIT_BYTES or m.split_key is None:
                 continue
             await self._split_and_move(i, m.split_key)
             return  # one relocation per round
+        # shardMerger (:379): two adjacent small shards on the SAME team
+        # collapse back into one — metadata-only (no data moves)
+        for i in range(len(teams) - 1):
+            if (teams[i] == teams[i + 1]
+                    and sizes[i] + sizes[i + 1] < KNOBS.DD_SHARD_MERGE_BYTES):
+                await self._merge(i)
+                return
+
+    async def _merge(self, i: int):
+        """Drop the boundary between shards i and i+1 (same team): update
+        proxies, publish through the cstate, then DBInfo. Stale layouts stay
+        correct — the union of the halves is exactly the merged shard on the
+        same servers."""
+        info = self.dbinfo
+        b = list(info.shard_boundaries)
+        teams = [list(t) for t in info.teams()]
+        new_b = b[:i + 1] + b[i + 2:]
+        new_teams = teams[:i + 1] + teams[i + 2:]
+        TraceEvent("DDMergeShards", self.process.address) \
+            .detail("At", b[i + 1].hex()).log()
+        for pa in info.proxies:
+            await self.loop.timeout(self.net.request(
+                self.process, Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
+                UpdateShardsRequest(boundaries=new_b, tags=new_teams)), 2.0)
+        await self._publish_layout(new_b, new_teams)
+        # the merged team's storage servers must coalesce their served
+        # ranges too: _owns_range requires a request to fit ONE entry, so a
+        # post-merge range read spanning the former boundary would get
+        # wrong_shard_server forever from a team with explicit shard_ranges
+        addr_of_tag = {t: a for a, t in info.storages}
+        self._push_team_ranges(teams[i], new_b, new_teams, addr_of_tag)
+
+    def _team_ranges(self, team, boundaries, teams):
+        return [(boundaries[j],
+                 boundaries[j + 1] if j + 1 < len(boundaries) else None)
+                for j, t in enumerate(teams) if t == team]
+
+    def _push_team_ranges(self, team, boundaries, teams, addr_of_tag):
+        ranges = self._team_ranges(team, boundaries, teams)
+        for tag in team:
+            self.net.one_way(self.process,
+                             Endpoint(addr_of_tag[tag],
+                                      Token.STORAGE_SET_SHARDS),
+                             SetShardsRequest(shard_ranges=ranges))
+
+    async def _publish_layout(self, new_b, new_teams):
+        """Shared publish step for every DD layout change: the coordinated
+        state FIRST (a racing recovery must see a consistent layout), then
+        DBInfo for clients. Aborts if the epoch moved or we were deposed."""
+        info = self.dbinfo
+        prior, _gen = await self.cstate.read()
+        if prior is None or prior.get("epoch") != info.epoch or self.deposed:
+            raise FDBError("coordinators_changed", "layout changed under DD")
+        prior["shard_boundaries"] = new_b
+        prior["shard_tags"] = new_teams
+        await self.cstate.write(prior)
+        self.dbinfo = DBInfo(
+            version=info.version + 1, epoch=info.epoch, master=info.master,
+            proxies=info.proxies, resolvers=info.resolvers,
+            log_epochs=info.log_epochs, storages=info.storages,
+            shard_boundaries=new_b, recovery_state="accepting_commits",
+            ratekeeper=info.ratekeeper, shard_tags=new_teams)
 
     async def _split_and_move(self, i: int, split_key: bytes):
         info = self.dbinfo
@@ -612,18 +676,7 @@ class ClusterController:
                                     fence_version=fence)), 30.0)
         # 4. publish: cstate first (a concurrent recovery must see the new
         # layout), then DBInfo for clients; finally shrink the source
-        prior, _gen = await self.cstate.read()
-        if prior is None or prior.get("epoch") != info.epoch or self.deposed:
-            raise FDBError("coordinators_changed", "layout changed under DD")
-        prior["shard_boundaries"] = new_b
-        prior["shard_tags"] = new_teams
-        await self.cstate.write(prior)
-        self.dbinfo = DBInfo(
-            version=info.version + 1, epoch=info.epoch, master=info.master,
-            proxies=info.proxies, resolvers=info.resolvers,
-            log_epochs=info.log_epochs, storages=info.storages,
-            shard_boundaries=new_b, recovery_state="accepting_commits",
-            ratekeeper=info.ratekeeper, shard_tags=new_teams)
+        await self._publish_layout(new_b, new_teams)
         # 5. end the dual-route window: final single-team routing, then the
         # source stops serving the moved range (stale clients get
         # wrong_shard_server and re-resolve through the published layout)
@@ -633,10 +686,4 @@ class ClusterController:
                              UpdateShardsRequest(boundaries=new_b,
                                                  tags=new_teams))
         if dest != old_team:
-            keep = [(new_b[j], new_b[j + 1] if j + 1 < len(new_b) else None)
-                    for j, t in enumerate(new_teams) if t == old_team]
-            for tag in old_team:
-                self.net.one_way(self.process,
-                                 Endpoint(addr_of_tag[tag],
-                                          Token.STORAGE_SET_SHARDS),
-                                 SetShardsRequest(shard_ranges=keep))
+            self._push_team_ranges(old_team, new_b, new_teams, addr_of_tag)

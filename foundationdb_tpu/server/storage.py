@@ -333,10 +333,18 @@ class StorageServer:
                    for b, e in self.shard_ranges)
 
     def _owns_range(self, begin: bytes, end: bytes) -> bool:
+        """A request is in-shard when the UNION of contiguous served entries
+        covers it — after a layout merge a client legitimately reads across
+        a former boundary between two entries this server holds."""
         if self.shard_ranges is None:
             return True
-        return any(b <= begin and (e is None or end <= e)
-                   for b, e in self.shard_ranges)
+        cur = begin
+        for b, e in sorted(self.shard_ranges):
+            if b <= cur and (e is None or cur < e):
+                if e is None or end <= e:
+                    return True
+                cur = e  # contiguous continuation may cover the rest
+        return False
 
     async def _wait_for_version(self, version: int) -> None:
         """waitForVersion (:654): too-new reads wait (bounded), dead reads throw.

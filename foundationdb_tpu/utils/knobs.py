@@ -114,6 +114,7 @@ KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
 KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
 KNOBS.init("DD_SHARD_SPLIT_BYTES", 500_000, (5_000,))  # shardSplitter :314 threshold
+KNOBS.init("DD_SHARD_MERGE_BYTES", 50_000, (500,))  # shardMerger :379 threshold
 KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)
 KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
 

@@ -132,3 +132,111 @@ def test_split_survives_recovery():
         assert len(rows) == 60
 
     c.run(c.loop.spawn(t()), max_time=120_000.0)
+
+
+def test_small_adjacent_shards_merge_back():
+    """After the load that forced a split is cleared, two small adjacent
+    same-team shards merge back (shardMerger :379) — metadata only, data
+    intact."""
+    KNOBS.set("DD_SHARD_SPLIT_BYTES", 4_000)
+    KNOBS.set("DD_SHARD_MERGE_BYTES", 2_000)
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    c = RecoverableCluster(seed=93, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=1, n_replicas=1)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        async def fill(tr):
+            for j in range(60):
+                tr.set(b"m%03d" % j, b"z" * 150)
+        await db.transact(fill, max_retries=300)
+        for _ in range(120):
+            if len(c.current_cc().dbinfo.shard_boundaries) > 1:
+                break
+            await c.loop.delay(0.5)
+        n_split = len(c.current_cc().dbinfo.shard_boundaries)
+        assert n_split > 1, "no split happened"
+
+        # clear the bulk: both halves now tiny and on the same team
+        async def clear(tr):
+            tr.clear_range(b"m", b"n")
+        await db.transact(clear, max_retries=300)
+        async def keep(tr):
+            tr.set(b"keeper", b"1")
+        await db.transact(keep, max_retries=300)
+        for _ in range(240):
+            if len(c.current_cc().dbinfo.shard_boundaries) < n_split:
+                break
+            await c.loop.delay(0.5)
+        assert len(c.current_cc().dbinfo.shard_boundaries) < n_split, \
+            "no merge happened"
+        async def read(tr):
+            return await tr.get(b"keeper")
+        assert await db.transact(read, max_retries=300) == b"1"
+
+    c.run(c.loop.spawn(t()), max_time=240_000.0)
+
+
+def test_merge_after_move_coalesces_storage_ranges():
+    """Regression: a team that acquired shards through MOVES holds explicit
+    per-shard ranges; merges must also coalesce the storage servers' served
+    ranges, or range reads spanning former boundaries get
+    wrong_shard_server forever."""
+    KNOBS.set("DD_SHARD_SPLIT_BYTES", 4_000)
+    KNOBS.set("DD_SHARD_MERGE_BYTES", 2_000)
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    c = RecoverableCluster(seed=94, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=2, n_replicas=1)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        # fill until MULTIPLE splits happened: with two teams, the second
+        # split of a team already serving two shards must MOVE (least-loaded
+        # policy), leaving explicit multi-entry storage ranges around
+        async def fill(tr):
+            for j in range(40):
+                tr.set(b"\x10f%03d" % j, b"z" * 150)
+        async def fill2(tr):
+            for j in range(40, 80):
+                tr.set(b"\x10f%03d" % j, b"z" * 150)
+        await db.transact(fill, max_retries=300)
+        await db.transact(fill2, max_retries=300)
+        moved = False
+        for _ in range(200):
+            info = c.current_cc().dbinfo
+            teams = [tuple(t) for t in info.teams()]
+            moved = any(teams[j] == teams[j + 1] for j in
+                        range(len(teams) - 1)) and len(set(teams)) > 1 \
+                and len(teams) >= 4
+            if moved:
+                break
+            await c.loop.delay(0.5)
+        assert len(c.current_cc().dbinfo.shard_boundaries) >= 3, "no splits"
+
+        # clear the bulk so adjacent same-team shards merge back
+        async def clear(tr):
+            tr.clear_range(b"\x10", b"\x11")
+        await db.transact(clear, max_retries=300)
+        async def keep(tr):
+            for kb in (b"\x20a", b"\x55b", b"\x81c", b"\xc0d"):
+                tr.set(kb, b"v")
+        await db.transact(keep, max_retries=300)
+        n_now = len(c.current_cc().dbinfo.shard_boundaries)
+        for _ in range(240):
+            if len(c.current_cc().dbinfo.shard_boundaries) < n_now:
+                break
+            await c.loop.delay(0.5)
+        assert len(c.current_cc().dbinfo.shard_boundaries) < n_now, \
+            "no merge happened"
+        await c.loop.delay(5.0)  # let further merges settle
+
+        # spanning reads across every former boundary must succeed
+        async def span(tr):
+            return await tr.get_range(b"\x11", b"\xff")
+        rows = await db.transact(span, max_retries=100)
+        assert {k for k, _v in rows} >= {b"\x20a", b"\x55b", b"\x81c",
+                                         b"\xc0d"}, rows
+
+    c.run(c.loop.spawn(t()), max_time=240_000.0)
