@@ -231,7 +231,20 @@ class Transaction:
                 + getattr(self, "_extra_write_conflicts", []),
                 mutations=list(self._writes.mutations))
             self._check_size(req)
-            reply = await self.db._commit(req)
+            try:
+                reply = await self.db._commit(req)
+            except FDBError as e:
+                if e.name in ("request_maybe_delivered", "timed_out",
+                              "broken_promise"):
+                    # The commit RPC was lost/dropped/peer-died AFTER the
+                    # request may have reached the proxy: the transaction may
+                    # have committed. Surface the reference's dedicated error
+                    # (NativeAPI tryCommit maps request_maybe_delivered ->
+                    # commit_unknown_result) so applications can run their
+                    # idempotency check before retrying; on_error still
+                    # treats it as retryable.
+                    raise FDBError("commit_unknown_result", e.detail) from e
+                raise
             self._committed_version = reply.version
         finally:
             self._committing = False

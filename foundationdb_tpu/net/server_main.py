@@ -46,7 +46,9 @@ def build_role(process, role: str, args: dict):
         return Resolver(process, **args)
     if role == "tlog":
         from foundationdb_tpu.server.tlog import TLog
-        return TLog(process, **args)
+        t = TLog(process, **args)
+        t.recover_from_file()  # real deployments reboot onto surviving files
+        return t
     if role == "storage":
         from foundationdb_tpu.server.storage import StorageServer
         return StorageServer(process, **args)
@@ -64,8 +66,25 @@ def main(spec_json: str):
     net = NetTransport(loop, spec["listen"],
                        data_dir=spec.get("data_dir", "/tmp/fdbtpu"))
     net.start()
-    roles = [build_role(net.process, r["role"], r.get("args", {}))
-             for r in spec["roles"]]
+    # TLogs boot first so '@recover:local_tlog' args can fence version
+    # allocation past what this process's logs durably reached — the static-
+    # topology stand-in for coordinated recovery (a restarted master that
+    # re-issues old versions would be silently ignored by storage; the
+    # reference's master always recovers its version from the log system,
+    # masterserver.actor.cpp recoverFrom).
+    ordered = sorted(spec["roles"],
+                     key=lambda r: 0 if r["role"] in ("tlog", "storage") else 1)
+    roles = []
+    built = {}
+    for r in ordered:
+        args = dict(r.get("args", {}))
+        for k, v in args.items():
+            if v == "@recover:local_tlog":
+                tlogs = built.get("tlog", [])
+                args[k] = max((t.version.get() for t in tlogs), default=0)
+        role = build_role(net.process, r["role"], args)
+        built.setdefault(r["role"], []).append(role)
+        roles.append(role)
     print(f"ready {spec['listen']} roles={[r['role'] for r in spec['roles']]}",
           flush=True)
     try:

@@ -14,8 +14,9 @@ mirrors SimProcess (register/spawn), NetTransport mirrors SimNetwork
 with real time on top of asyncio. The sim is the test bed; this is the
 deployment path.
 
-Wire format (serialize.h's length-prefixed BinaryWriter framing, pickled
-payloads as the placeholder body encoding):
+Wire format (serialize.h's length-prefixed BinaryWriter framing; bodies are
+utils/wire.py typed frames — decode builds only registry-whitelisted types,
+so a hostile peer can corrupt its own requests but never execute code here):
   u32 length | u64 token | u64 reply_id | u8 kind | crc32 u32 | body
 kind: 0 = request, 1 = reply, 2 = reply-error, 3 = one-way.
 """
@@ -23,10 +24,11 @@ kind: 0 = request, 1 = reply, 2 = reply-error, 3 = one-way.
 from __future__ import annotations
 
 import asyncio
-import pickle
 import struct
 import time
 import zlib
+
+from foundationdb_tpu.utils import wire
 
 from foundationdb_tpu.core.eventloop import EventLoop, TaskPriority
 from foundationdb_tpu.core.future import Future, Promise
@@ -223,16 +225,17 @@ class NetTransport:
 
         async def send():
             try:
+                body = wire.dumps(payload)
                 w = await self._peer(dest.address)
-                w.write(self._frame(dest.token, reply_id, _REQUEST,
-                                    pickle.dumps(payload)))
+                w.write(self._frame(dest.token, reply_id, _REQUEST, body))
                 await w.drain()
-            except OSError:
-                self._peers.pop(dest.address, None)
+            except (OSError, wire.WireError) as e:
+                if isinstance(e, OSError):
+                    self._peers.pop(dest.address, None)
                 entry = self._pending.pop(reply_id, None)
                 if entry is not None and not entry[0].is_set():
                     entry[0].send_error(FDBError("broken_promise",
-                                                 "connect failed"))
+                                                 "connect/encode failed"))
 
         self.loop.aio.create_task(send())
         if timeout is not None:
@@ -246,10 +249,12 @@ class NetTransport:
     def one_way(self, src, dest, payload):
         async def send():
             try:
+                body = wire.dumps(payload)
                 w = await self._peer(dest.address)
-                w.write(self._frame(dest.token, 0, _ONE_WAY,
-                                    pickle.dumps(payload)))
+                w.write(self._frame(dest.token, 0, _ONE_WAY, body))
                 await w.drain()
+            except wire.WireError:
+                pass  # unserializable one-way == dropped packet
             except OSError:
                 self._peers.pop(dest.address, None)
         self.loop.aio.create_task(send())
@@ -261,8 +266,15 @@ class NetTransport:
         length, token, reply_id, kind, crc = _HEADER.unpack(header)
         body = await reader.readexactly(length)
         if zlib.crc32(body) != crc:
-            raise FDBError("file_corrupt", "packet checksum mismatch")
-        return token, reply_id, kind, pickle.loads(body)
+            raise ConnectionError("packet checksum mismatch")
+        try:
+            payload = wire.loads(body)
+        except wire.WireError as e:
+            # undecodable frame: the stream is garbage or hostile — drop the
+            # connection (peers reconnect; in-flight requests get
+            # broken_promise from the reply-reader's cleanup)
+            raise ConnectionError(f"bad wire frame: {e}") from e
+        return token, reply_id, kind, payload
 
     async def _on_connection(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
@@ -280,7 +292,7 @@ class NetTransport:
                     # packet from this peer would silently hang otherwise)
                     if kind == _REQUEST:
                         writer.write(self._frame(0, reply_id, _REPLY_ERROR,
-                                                 pickle.dumps("unknown_error")))
+                                                 wire.dumps("unknown_error")))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return
 
@@ -290,19 +302,25 @@ class NetTransport:
             # TOKEN_IGNORE path: tell the caller its promise is broken
             if kind == _REQUEST:
                 writer.write(self._frame(0, reply_id, _REPLY_ERROR,
-                                         pickle.dumps("broken_promise")))
+                                         wire.dumps("broken_promise")))
             return
         inner = Promise()
         if kind == _REQUEST:
             def on_reply(f: Future):
                 try:
                     if f.is_error():
-                        body = pickle.dumps(getattr(f._result, "name",
+                        body = wire.dumps(getattr(f._result, "name",
                                                     "unknown_error"))
                         writer.write(self._frame(0, reply_id, _REPLY_ERROR, body))
                     else:
-                        writer.write(self._frame(0, reply_id, _REPLY,
-                                                 pickle.dumps(f._result)))
+                        try:
+                            body = wire.dumps(f._result)
+                        except wire.WireError:
+                            writer.write(self._frame(
+                                0, reply_id, _REPLY_ERROR,
+                                wire.dumps("unknown_error")))
+                            return
+                        writer.write(self._frame(0, reply_id, _REPLY, body))
                 except OSError:
                     pass
             inner.future.add_callback(on_reply)

@@ -180,7 +180,7 @@ class ConflictShapes:
 
 
 def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
-                  max_write_life: int):
+                  max_write_life: int, ablate: str = ""):
     """Pure function: (state, batch) -> (state', statuses, info). Jit-able.
 
     state:
@@ -210,19 +210,31 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     too_old = txn_valid & has_reads & (snapshot < oldest)
 
     # ---- 2. history check: range-max of step function vs snapshot ----
-    # one fused bisection: [rb -> upper bound, re -> lower bound]
-    hist_q = jnp.concatenate([rb, re], axis=1)
-    hist_side = jnp.concatenate([jnp.ones(NR, bool), jnp.zeros(NR, bool)])
-    hist_idx = _searchsorted(bkeys, hist_q, hist_side)
-    i0 = hist_idx[:NR] - 1  # segment containing begin
-    i1 = hist_idx[NR:]  # first boundary >= end
-    i0 = jnp.maximum(i0, 0)
-    nonempty = _key_lt(rb, re)
-    maxver = _range_max(table, i0, jnp.maximum(i1, i0 + 1))
-    rsnap = snapshot[jnp.minimum(rtxn, T - 1)]
-    read_hits = rvalid & nonempty & (maxver > rsnap)
-    hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
+    if ablate in ("no_hist", "only_merge"):
+        hist_conflict = jnp.zeros(T, bool)
+    else:
+        # one fused bisection: [rb -> upper bound, re -> lower bound]
+        hist_q = jnp.concatenate([rb, re], axis=1)
+        hist_side = jnp.concatenate([jnp.ones(NR, bool), jnp.zeros(NR, bool)])
+        hist_idx = _searchsorted(bkeys, hist_q, hist_side)
+        i0 = hist_idx[:NR] - 1  # segment containing begin
+        i1 = hist_idx[NR:]  # first boundary >= end
+        i0 = jnp.maximum(i0, 0)
+        nonempty = _key_lt(rb, re)
+        maxver = _range_max(table, i0, jnp.maximum(i1, i0 + 1))
+        rsnap = snapshot[jnp.minimum(rtxn, T - 1)]
+        read_hits = rvalid & nonempty & (maxver > rsnap)
+        hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
 
+    g0 = txn_valid & ~too_old & ~hist_conflict
+    if ablate in ("no_intra", "only_merge", "only_hist"):
+        commit = g0
+        statuses = jnp.where(
+            commit, COMMITTED,
+            jnp.where(too_old, TOO_OLD, CONFLICT)).astype(jnp.int32)
+        statuses = jnp.where(txn_valid, statuses, COMMITTED)
+        return _merge_phase(state, batch, statuses, commit, shapes,
+                            max_write_life, ablate)
     # ---- 3. intra-batch: endpoint ranks -> pairwise overlap -> fixpoint ----
     # The (T,T) dependency matrix of the first design required a 2D scatter
     # (~170ms/batch on TPU); instead the fixpoint operates directly on the
@@ -289,6 +301,28 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
         commit, COMMITTED,
         jnp.where(too_old, TOO_OLD, CONFLICT)).astype(jnp.int32)
     statuses = jnp.where(txn_valid, statuses, COMMITTED)
+    return _merge_phase(state, batch, statuses, commit, shapes,
+                        max_write_life, ablate)
+
+
+def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
+                 ablate=""):
+    T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
+    bkeys, bval, nb, oldest = (
+        state["bkeys"], state["bval"], state["nb"], state["oldest"])
+    wb, we, wtxn = batch["wb"], batch["we"], batch["wtxn"]
+    vnew = batch["commit_version"]
+    wvalid = wtxn < T
+    wtxn_c = jnp.minimum(wtxn, T - 1)
+
+    if ablate in ("no_merge", "only_hist"):
+        new_oldest = jnp.maximum(
+            oldest, jnp.where(batch["advance_floor"],
+                              vnew - jnp.int32(max_write_life), oldest))
+        new_state = dict(state, oldest=new_oldest.astype(jnp.int32))
+        info = {"overflow": state["poisoned"], "boundaries": nb,
+                "committed": jnp.sum(commit.astype(jnp.int32))}
+        return new_state, statuses, info
 
     # ---- 4. merge surviving writes into the step function at vnew ----
     # Incremental: only the 2NW candidate endpoints are sorted (the state's K
@@ -436,7 +470,7 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     out_keys = jnp.where(poisoned, pois_keys, out_keys)
     out_vals = jnp.where(poisoned, pois_vals, out_vals)
     n2 = jnp.where(poisoned, 1, n2)
-    new_table = _build_table(out_vals)
+    new_table = state["table"] if ablate == "no_table" else _build_table(out_vals)
 
     new_state = {
         "bkeys": out_keys,

@@ -28,6 +28,7 @@ from typing import Any
 
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils import wire
 
 
 class CoordToken:
@@ -124,11 +125,13 @@ class Coordinator:
             process.net.open_file(process, "coord.0"),
             process.net.open_file(process, "coord.1"))
         self.store.recover()
-        import pickle
         self._regs: dict[str, tuple[Any, int, int]] = {}  # key -> (value, vgen, rgen)
         raw = self.store.get_metadata("regs")
         if raw:
-            self._regs = pickle.loads(raw)
+            try:
+                self._regs = wire.loads(raw)
+            except wire.WireError as e:
+                raise FDBError("file_corrupt", f"coordinator regs undecodable: {e}")
         self.nominee: str | None = None
         self.nominee_priority = -1
         self.nominee_expiry = 0.0
@@ -139,8 +142,7 @@ class Coordinator:
         process.register(CoordToken.GENERATION_PEEK, self._on_peek)
 
     def _persist(self):
-        import pickle
-        self.store.set_metadata("regs", pickle.dumps(self._regs))
+        self.store.set_metadata("regs", wire.dumps(self._regs))
         self.store.commit()
 
     def _on_peek(self, req: GenReadRequest, reply):

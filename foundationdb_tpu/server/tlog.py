@@ -22,8 +22,9 @@ Bounded memory (updatePersistentData :548 spill + peek reply limits):
 
 from __future__ import annotations
 
-import pickle
 from collections import deque
+
+from foundationdb_tpu.utils import wire
 
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
@@ -75,7 +76,7 @@ class TLog:
             self.locked = True
             # persist the fence: a rebooted locked TLog must stay locked or a
             # zombie old-generation proxy could commit past the recovery point
-            self.queue.push(pickle.dumps({"lock": req.epoch}))
+            self.queue.push(wire.dumps({"lock": req.epoch}))
             self.queue.commit()
         reply.send(TLogLockReply(
             known_committed_version=self.known_committed_version,
@@ -105,7 +106,7 @@ class TLog:
         self.known_committed_version = max(self.known_committed_version,
                                            req.known_committed_version)
         # durable push + commit, then reply (group commit = one sync per batch)
-        seq = self.queue.push(pickle.dumps((req.version, req.messages)))
+        seq = self.queue.push(wire.dumps((req.version, req.messages)))
         self.queue.commit()
         self._version_seq.append((req.version, seq))
         self.version.set(req.version)
@@ -153,7 +154,7 @@ class TLog:
             for seq, payload in self.queue.live_entries:
                 if seq < start_seq:
                     continue
-                obj = pickle.loads(payload)
+                obj = wire.loads(payload)
                 if isinstance(obj, dict):
                     continue  # lock marker
                 version, messages = obj
@@ -222,7 +223,10 @@ class TLog:
         """Rebuild in-memory deques from the durable queue after a reboot."""
         last = self.version.get()
         for seq, payload in self.queue.recover():
-            obj = pickle.loads(payload)
+            try:
+                obj = wire.loads(payload)
+            except wire.WireError as e:
+                raise FDBError("file_corrupt", f"tlog queue entry undecodable: {e}")
             if isinstance(obj, dict) and "lock" in obj:
                 self.locked = True
                 continue

@@ -20,10 +20,10 @@ deterministic scheduling point, so simulation determinism is preserved).
 from __future__ import annotations
 
 import bisect
-import pickle
 from typing import Iterable, Protocol
 
 from foundationdb_tpu.storage.diskqueue import DiskQueue
+from foundationdb_tpu.utils import wire
 from foundationdb_tpu.utils.errors import FDBError
 
 # WAL op tags
@@ -116,7 +116,7 @@ class MemoryKeyValueStore:
 
     def commit(self) -> None:
         if self._pending:
-            self.queue.push(pickle.dumps(self._pending))
+            self.queue.push(wire.dumps(self._pending))
             self._ops_since_snapshot += len(self._pending)
             self._pending = []
         if self._ops_since_snapshot >= self.SNAPSHOT_OPS:
@@ -126,7 +126,7 @@ class MemoryKeyValueStore:
     def _write_snapshot(self):
         """Full-state snapshot entry, then pop everything before it — the
         memory engine's log compaction (KeyValueStoreMemory semantics)."""
-        snap = pickle.dumps(
+        snap = wire.dumps(
             [(_OP_SNAPSHOT, list(self._data.items()), dict(self._meta))])
         seq = self.queue.push(snap)
         self.queue.commit()
@@ -139,7 +139,14 @@ class MemoryKeyValueStore:
         self._meta.clear()
         self._pending = []
         for _seq, payload in self.queue.recover():
-            for op in pickle.loads(payload):
+            try:
+                ops = wire.loads(payload)
+            except wire.WireError as e:
+                # DiskQueue checksums passed but the body is not ours: not a
+                # torn tail, an incompatible/corrupt store (file_corrupt in
+                # the reference's IKeyValueStore recovery)
+                raise FDBError("file_corrupt", f"WAL entry undecodable: {e}")
+            for op in ops:
                 if op[0] == _OP_SNAPSHOT:
                     self._data = dict(op[1])
                     self._meta = dict(op[2])

@@ -1,0 +1,355 @@
+"""Versioned, typed wire/durable encoding — the serialize.h equivalent.
+
+Reference: flow/serialize.h:188-241 (BinaryWriter/BinaryReader with protocol
+versioning) and fdbrpc's ObjectSerializer. The reference serializes typed
+structs field-by-field behind a protocol version; deserialization never
+executes arbitrary code. This module does the same for the framework's
+dataclass payloads: a small tagged binary format plus an explicit type
+registry. Unlike pickle (the round-1/2 placeholder), decode can only build
+whitelisted types — safe on untrusted bytes — and the format is versioned so
+mixed-version clusters can reject frames they don't understand.
+
+Format: one message = MAGIC byte, version byte, then one value.
+Value = tag byte + payload:
+  N none | T/F bool | i zigzag-varint int | d f64 | b bytes | s utf8 str
+  l list | t tuple | m dict | S set | E enum (type-id varint + value varint)
+  R registered struct: type-id varint, field-count varint, field values in
+    dataclass declaration order. A decoder with a NEWER schema fills missing
+    trailing fields from defaults; with an OLDER schema it ignores extras —
+    the same forward/backward rule protocol-versioned BinaryReader gives the
+    reference.
+
+Struct/enum ids are pinned in _REGISTRY below (never renumber — append).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import MISSING, fields, is_dataclass
+from enum import IntEnum
+
+MAGIC = 0xF5
+WIRE_VERSION = 1
+
+_F64 = struct.Struct(">d")
+
+
+class WireError(Exception):
+    """Malformed or out-of-policy bytes. Deliberately NOT an FDBError: the
+    caller decides whether this is file_corrupt (durable) or a dropped
+    connection (network)."""
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_BY_ID: dict[int, type] = {}
+_BY_TYPE: dict[type, int] = {}
+_FIELDS: dict[int, tuple] = {}  # id -> dataclass fields tuple
+_loaded = False
+
+
+def _ensure_registry():
+    global _loaded
+    if not _loaded:
+        _loaded = True
+        _register_all()
+
+
+def register(type_id: int, cls: type):
+    """Pin `cls` at `type_id`. Ids are part of the wire format: append-only."""
+    if type_id in _BY_ID and _BY_ID[type_id] is not cls:
+        raise ValueError(f"wire type id {type_id} already bound to {_BY_ID[type_id]}")
+    _BY_ID[type_id] = cls
+    _BY_TYPE[cls] = type_id
+    if is_dataclass(cls):
+        _FIELDS[type_id] = fields(cls)
+    return cls
+
+
+def _registered_id(cls: type) -> int:
+    tid = _BY_TYPE.get(cls)
+    if tid is None:
+        raise WireError(f"type {cls.__name__} is not wire-registered")
+    return tid
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def _w_varint(out: bytearray, v: int):
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_zigzag(out: bytearray, v: int):
+    # arbitrary-precision zigzag: versions are int64 but nothing here caps at it
+    _w_varint(out, (v << 1) if v >= 0 else (-v << 1) - 1)
+
+
+class _Reader:
+    __slots__ = ("data", "pos", "end")
+
+    def __init__(self, data: bytes, pos: int = 0):
+        self.data = data
+        self.pos = pos
+        self.end = len(data)
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > self.end:
+            raise WireError("truncated")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def byte(self) -> int:
+        if self.pos >= self.end:
+            raise WireError("truncated")
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        shift = 0
+        v = 0
+        while True:
+            if shift > 1100:  # ~1024-bit bound: big ints round-trip, frames
+                raise WireError("varint overflow")  # can't allocate unbounded
+            b = self.byte()
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) if not v & 1 else -((v + 1) >> 1)
+
+
+# ---------------------------------------------------------------------------
+# values
+# ---------------------------------------------------------------------------
+
+def _encode_value(out: bytearray, obj):
+    if obj is None:
+        out.append(ord("N"))
+    elif obj is True:
+        out.append(ord("T"))
+    elif obj is False:
+        out.append(ord("F"))
+    elif isinstance(obj, IntEnum):
+        out.append(ord("E"))
+        _w_varint(out, _registered_id(type(obj)))
+        _w_zigzag(out, int(obj))
+    elif isinstance(obj, int):
+        out.append(ord("i"))
+        _w_zigzag(out, obj)
+    elif isinstance(obj, float):
+        out.append(ord("d"))
+        out += _F64.pack(obj)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        out.append(ord("b"))
+        b = bytes(obj)
+        _w_varint(out, len(b))
+        out += b
+    elif isinstance(obj, str):
+        out.append(ord("s"))
+        b = obj.encode("utf-8")
+        _w_varint(out, len(b))
+        out += b
+    elif isinstance(obj, list):
+        out.append(ord("l"))
+        _w_varint(out, len(obj))
+        for x in obj:
+            _encode_value(out, x)
+    elif isinstance(obj, tuple):
+        out.append(ord("t"))
+        _w_varint(out, len(obj))
+        for x in obj:
+            _encode_value(out, x)
+    elif isinstance(obj, dict):
+        out.append(ord("m"))
+        _w_varint(out, len(obj))
+        for k, v in obj.items():
+            _encode_value(out, k)
+            _encode_value(out, v)
+    elif isinstance(obj, (set, frozenset)):
+        out.append(ord("S"))
+        _w_varint(out, len(obj))
+        for x in obj:
+            _encode_value(out, x)
+    elif is_dataclass(obj):
+        tid = _registered_id(type(obj))
+        out.append(ord("R"))
+        _w_varint(out, tid)
+        fs = _FIELDS[tid]
+        _w_varint(out, len(fs))
+        for f in fs:
+            _encode_value(out, getattr(obj, f.name))
+    else:
+        # last resort: anything indexable as an int (numpy scalars from
+        # device fetches routinely leak into versions/counters)
+        try:
+            out.append(ord("i"))
+            _w_zigzag(out, obj.__index__())
+        except AttributeError:
+            raise WireError(f"unserializable type {type(obj).__name__}") from None
+
+
+_MAX_CONTAINER = 1 << 24  # sanity bound: one frame never has 16M+ elements
+_MAX_DEPTH = 64  # hostile nesting must raise WireError, not RecursionError
+
+
+def _decode_value(r: _Reader, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        raise WireError("nesting too deep")
+    tag = r.byte()
+    if tag == ord("N"):
+        return None
+    if tag == ord("T"):
+        return True
+    if tag == ord("F"):
+        return False
+    if tag == ord("i"):
+        return r.zigzag()
+    if tag == ord("d"):
+        return _F64.unpack(r.take(8))[0]
+    if tag == ord("b"):
+        return r.take(r.varint())
+    if tag == ord("s"):
+        try:
+            return r.take(r.varint()).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireError("bad utf-8") from e
+    if tag in (ord("l"), ord("t"), ord("S")):
+        n = r.varint()
+        if n > _MAX_CONTAINER:
+            raise WireError("container too large")
+        items = [_decode_value(r, depth + 1) for _ in range(n)]
+        if tag == ord("t"):
+            return tuple(items)
+        if tag == ord("S"):
+            try:
+                return set(items)
+            except TypeError as e:
+                raise WireError("unhashable set element") from e
+        return items
+    if tag == ord("m"):
+        n = r.varint()
+        if n > _MAX_CONTAINER:
+            raise WireError("container too large")
+        out = {}
+        for _ in range(n):
+            k = _decode_value(r, depth + 1)
+            v = _decode_value(r, depth + 1)
+            try:
+                out[k] = v
+            except TypeError as e:
+                raise WireError("unhashable dict key") from e
+        return out
+    if tag == ord("E"):
+        tid = r.varint()
+        cls = _BY_ID.get(tid)
+        v = r.zigzag()
+        if cls is None or not issubclass(cls, IntEnum):
+            raise WireError(f"unknown enum id {tid}")
+        try:
+            return cls(v)
+        except ValueError as e:
+            raise WireError(f"bad enum value {v}") from e
+    if tag == ord("R"):
+        tid = r.varint()
+        cls = _BY_ID.get(tid)
+        if cls is None or tid not in _FIELDS:
+            raise WireError(f"unknown struct id {tid}")
+        n = r.varint()
+        if n > 256:
+            raise WireError("struct too wide")
+        vals = [_decode_value(r, depth + 1) for _ in range(n)]
+        fs = _FIELDS[tid]
+        vals = vals[:len(fs)]  # older schema sent extras we no longer have
+        for f in fs[len(vals):]:  # newer schema: fill from defaults
+            if f.default is not MISSING:
+                vals.append(f.default)
+            elif f.default_factory is not MISSING:
+                vals.append(f.default_factory())
+            else:
+                raise WireError(f"missing required field {cls.__name__}.{f.name}")
+        try:
+            return cls(*vals)
+        except TypeError as e:
+            raise WireError(f"bad struct {cls.__name__}") from e
+    raise WireError(f"unknown tag {tag:#x}")
+
+
+def dumps(obj) -> bytes:
+    _ensure_registry()
+    out = bytearray([MAGIC, WIRE_VERSION])
+    _encode_value(out, obj)
+    return bytes(out)
+
+
+def loads(data: bytes):
+    _ensure_registry()
+    r = _Reader(data)
+    if r.byte() != MAGIC:
+        raise WireError("bad magic")
+    v = r.byte()
+    if v > WIRE_VERSION:
+        raise WireError(f"wire version {v} from the future")
+    obj = _decode_value(r)
+    if r.pos != r.end:
+        raise WireError("trailing bytes")
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# the pinned registry (append-only; ids are wire format)
+# ---------------------------------------------------------------------------
+
+def _register_all():
+    from foundationdb_tpu.ops.batch import TxnConflictInfo
+    from foundationdb_tpu.server import interfaces as I
+    from foundationdb_tpu.utils.types import KeyRange, Mutation, MutationType
+
+    table = [
+        (1, Mutation), (2, MutationType), (3, KeyRange), (4, TxnConflictInfo),
+        (5, I.GetCommitVersionRequest), (6, I.GetCommitVersionReply),
+        (7, I.CommitTransactionRequest), (8, I.CommitReply),
+        (9, I.GetReadVersionRequest), (10, I.GetReadVersionReply),
+        (11, I.ResolveTransactionBatchRequest),
+        (12, I.ResolveTransactionBatchReply),
+        (13, I.TLogCommitRequest), (14, I.TLogCommitReply),
+        (15, I.TLogPeekRequest), (16, I.TLogPeekReply), (17, I.TLogPopRequest),
+        (18, I.GetValueRequest), (19, I.GetValueReply), (20, I.KeySelector),
+        (21, I.GetKeyValuesRequest), (22, I.GetKeyValuesReply),
+        (23, I.WatchValueRequest), (24, I.TLogLockRequest),
+        (25, I.TLogLockReply), (26, I.LogEpoch), (27, I.SetLogSystemRequest),
+        (28, I.GetStorageMetricsRequest), (29, I.ShardMetrics),
+        (30, I.AddShardRequest), (31, I.SetShardsRequest),
+        (32, I.UpdateShardsRequest), (33, I.InitRoleRequest),
+        (34, I.InitRoleReply), (35, I.RegisterWorkerRequest), (36, I.DBInfo),
+    ]
+    for tid, cls in table:
+        register(tid, cls)
+
+    from foundationdb_tpu.server import coordination as coord
+    from foundationdb_tpu.server import ratekeeper as rk
+    from foundationdb_tpu.server.clustercontroller import ClusterConfig
+
+    for tid, cls in [
+        (37, coord.GenReadRequest), (38, coord.GenReadReply),
+        (39, coord.GenWriteRequest), (40, coord.GenWriteReply),
+        (41, coord.CandidacyRequest), (42, coord.LeaderReply),
+        (43, rk.RateInfoReply), (44, rk.QueueStatsReply),
+        (45, ClusterConfig),
+    ]:
+        register(tid, cls)

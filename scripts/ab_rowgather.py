@@ -1,0 +1,99 @@
+"""A/B: bisection with (L,K) limb-gathers vs (K,8) row-gathers.
+
+Hypothesis (memory: gathers are latency-bound per output element): one
+row-gather of 8 lanes costs about the same as one element gather, so the
+row layout cuts bisection cost ~L x. Run both shapes in a scan to mimic the
+kernel's fused context.
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+K = 1 << 18
+Q = 65536
+STEPS = 19
+NB = 50
+L = 7
+
+rng = np.random.RandomState(0)
+state_np = np.sort(rng.randint(0, 1 << 30, size=K).astype(np.uint32))
+qs_np = rng.randint(0, 1 << 30, size=(NB, Q)).astype(np.uint32)
+
+# limb layout: (L, K), all limbs identical copies (cost model only)
+bk_limb = jnp.asarray(np.broadcast_to(state_np, (L, K)).copy())
+# row layout: (K, 8)
+bk_row = jnp.asarray(np.broadcast_to(state_np[:, None], (K, 8)).copy())
+# queries in both layouts
+q_limb = jnp.asarray(np.broadcast_to(qs_np[:, None, :], (NB, L, Q)).copy())
+q_row = jnp.asarray(np.broadcast_to(qs_np[:, :, None], (NB, Q, 8)).copy())
+
+
+def lt_limb(a, b):
+    lt = jnp.zeros(a.shape[1:], bool)
+    eq = jnp.ones(a.shape[1:], bool)
+    for i in range(L):
+        lt = lt | (eq & (a[i] < b[i]))
+        eq = eq & (a[i] == b[i])
+    return lt
+
+
+def lt_row(a, b):  # a, b: (Q, 8)
+    lt = jnp.zeros(a.shape[0], bool)
+    eq = jnp.ones(a.shape[0], bool)
+    for i in range(L):
+        lt = lt | (eq & (a[:, i] < b[:, i]))
+        eq = eq & (a[:, i] == b[:, i])
+    return lt
+
+
+@jax.jit
+def scan_limb(bk, qstack):
+    def step(carry, q):
+        lo = jnp.zeros(Q, jnp.int32)
+        hi = jnp.full(Q, K, jnp.int32)
+        for _ in range(STEPS):
+            mid = (lo + hi) // 2
+            midk = bk[:, mid]
+            go = lt_limb(midk, q) & (lo < hi)
+            lo = jnp.where(go, mid + 1, lo)
+            hi = jnp.where(go, hi, mid)
+        return carry + jnp.sum(lo), None
+    out, _ = lax.scan(step, jnp.int32(0), qstack)
+    return out
+
+
+@jax.jit
+def scan_row(bk, qstack):
+    def step(carry, q):
+        lo = jnp.zeros(Q, jnp.int32)
+        hi = jnp.full(Q, K, jnp.int32)
+        for _ in range(STEPS):
+            mid = (lo + hi) // 2
+            midk = bk[mid]  # (Q, 8) row gather
+            go = lt_row(midk, q) & (lo < hi)
+            lo = jnp.where(go, mid + 1, lo)
+            hi = jnp.where(go, hi, mid)
+        return carry + jnp.sum(lo), None
+    out, _ = lax.scan(step, jnp.int32(0), qstack)
+    return out
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    _ = int(out)  # sync via small fetch
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = int(out)
+        ts.append(time.perf_counter() - t0)
+    dt = min(ts)
+    print(f"{name:10s} {dt / NB * 1e3:8.3f} ms/bisection ({Q} queries, {STEPS} steps)")
+
+
+timed("limb", scan_limb, bk_limb, q_limb)
+timed("row", scan_row, bk_row, q_row)

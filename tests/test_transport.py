@@ -136,3 +136,109 @@ def test_multiprocess_cluster_serves_gets_and_commits(tmp_path):
             p.terminate()
         for p in procs:
             p.wait(timeout=10)
+
+
+def test_multiprocess_restart_recovers_wire_wal(tmp_path):
+    """SIGKILL the txn-subsystem process and restart it on the same data_dir:
+    the TLog recovers its wire-encoded disk queue, the master/resolver fence
+    version allocation past the recovered version (server_main's
+    '@recover:local_tlog'), new commits land, old data survives. Also fires
+    hostile bytes (garbage, bad crc, pickle) at the live port first — decode
+    failures must drop the connection, not the server."""
+    import signal
+
+    from foundationdb_tpu.client.database import Database, LocationCache
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.server.interfaces import Token
+
+    p_txn = f"127.0.0.1:{free_port()}"
+    p_storage = f"127.0.0.1:{free_port()}"
+    txn_spec = {
+        "listen": p_txn, "data_dir": str(tmp_path / "txn"),
+        "knobs": {"CONFLICT_BACKEND": "oracle"},
+        "roles": [
+            {"role": "master",
+             "args": {"recovery_version": "@recover:local_tlog"}},
+            {"role": "resolver",
+             "args": {"recovery_version": "@recover:local_tlog"}},
+            {"role": "tlog", "args": {}},
+            {"role": "proxy", "args": {
+                "proxy_id": 0,
+                "master": {"address": p_txn,
+                           "token": Token.MASTER_GET_COMMIT_VERSION},
+                "resolvers": {"boundaries": [b"".hex()],
+                              "endpoints": [{"address": p_txn,
+                                             "token": Token.RESOLVER_RESOLVE}]},
+                "tlogs": [{"address": p_txn, "token": Token.TLOG_COMMIT}],
+                "shards": {"boundaries": [b"".hex()], "tags": [[0]]},
+            }},
+        ],
+    }
+    storage_spec = {
+        "listen": p_storage, "data_dir": str(tmp_path / "storage"),
+        "knobs": {"CONFLICT_BACKEND": "oracle"},
+        "roles": [{"role": "storage",
+                   "args": {"tag": 0, "tlog_addrs": [p_txn]}}],
+    }
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+
+    def boot(spec):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.net.server_main",
+             json.dumps(spec)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        line = p.stdout.readline().decode()
+        assert line.startswith("ready"), line
+        return p
+
+    txn_p = boot(txn_spec)
+    sto_p = boot(storage_spec)
+    try:
+        loop = RealEventLoop()
+        client = NetTransport(loop, f"127.0.0.1:{free_port()}")
+        client.start()
+        db = Database(client.process, proxies=[p_txn],
+                      locations=LocationCache([b""], [[p_storage]]))
+
+        def run(coro, t=90.0):
+            return loop.run_future(loop.spawn(coro), max_time=t)
+
+        async def write_kv(k, v):
+            async def body(tr):
+                tr.set(k, v)
+            await db.transact(body, max_retries=50)
+
+        async def read_k(k):
+            async def body(tr):
+                return await tr.get(k)
+            return await db.transact(body, max_retries=50)
+
+        run(write_kv(b"before", b"alive"))
+
+        # hostile bytes at the live port: server must keep serving
+        import struct as _struct
+        import zlib as _zlib
+        host, port = p_txn.rsplit(":", 1)
+        body = b"\x80\x04junkpickle"
+        frame = _struct.pack(">IQQBI", len(body), 10, 1, 0,
+                             _zlib.crc32(body)) + body
+        for blob in (b"\x00" * 64, b"fdbtpu\x01" + b"\xff" * 200,
+                     b"fdbtpu\x01" + frame):
+            s = socket.create_connection((host, int(port)))
+            s.sendall(blob)
+            s.close()
+        run(write_kv(b"hostile", b"survived"))
+
+        txn_p.send_signal(signal.SIGKILL)
+        txn_p.wait(timeout=10)
+        time.sleep(0.5)
+        txn_p = boot(txn_spec)
+        run(write_kv(b"after", b"recovered"))
+        assert run(read_k(b"after")) == b"recovered"
+        assert run(read_k(b"before")) == b"alive"
+        client.close()
+    finally:
+        for p in (txn_p, sto_p):
+            p.terminate()
+        for p in (txn_p, sto_p):
+            p.wait(timeout=10)
