@@ -41,26 +41,32 @@ CHUNK = 100  # batches per conflict_scan dispatch (fixed shape: compile once)
 KEYSPACE = 20_000_000  # reference: randomInt(0, 20000000)
 MAX_SPAN = 10  # reference: key + 1 + randomInt(0, 10)
 CAPACITY = 1 << 18
+KEY_BYTES = 16  # reference setK keys (SkipList.cpp:913)
 WINDOW = 5_000_000  # MAX_WRITE_TRANSACTION_LIFE_VERSIONS (Knobs.cpp:30-34)
 VERSION_STEP = WINDOW // 8  # ~8 batches (~131k txns) of history in the window
 
 
 def _encode_batches(n_batches: int, seed: int, version0: int):
-    """Vectorized batch construction: int keys -> 8-byte big-endian keys ->
-    uint32 limbs, no per-transaction Python. Returns a stacked batch dict
-    (numpy, leading axis n_batches) matching conflict_step's batch layout."""
-    from foundationdb_tpu.ops.conflict import L
-    from foundationdb_tpu.utils.keys import KEY_BYTES
+    """Vectorized batch construction mirroring the reference's setK keys
+    EXACTLY (SkipList.cpp:909-922): 16-byte keys, 12 '.' bytes then the
+    4-byte big-endian integer. The engine runs at key_bytes=16 (5 limbs) —
+    the honest width for this workload, just as the CPU skiplist's memcmp
+    cost is set by these same 16 bytes. Returns a stacked batch dict (numpy,
+    leading axis n_batches) matching conflict_step's batch layout."""
+    assert KEY_BYTES >= 16, "keys_to_limbs hard-codes the 16-byte setK layout"
+    L = KEY_BYTES // 4 + 1  # 5
+    DOT = 0x2E2E2E2E  # '....'
 
     T = TXNS_PER_BATCH
     rng = np.random.RandomState(seed)
 
-    def keys_to_limbs(v):  # v: (n, T) int64 keys in [0, KEYSPACE+MAX_SPAN]
+    def keys_to_limbs(v):  # v: (n, T) int64 ints in [0, KEYSPACE+MAX_SPAN]
         out = np.zeros((v.shape[0], L, T), dtype=np.uint32)
-        out[:, 0, :] = (v >> 32).astype(np.uint32)
-        out[:, 1, :] = (v & 0xFFFFFFFF).astype(np.uint32)
-        out[:, L - 1, :] = 8  # all keys are exactly 8 bytes (< KEY_BYTES)
-        assert KEY_BYTES >= 8
+        out[:, 0, :] = DOT
+        out[:, 1, :] = DOT
+        out[:, 2, :] = DOT
+        out[:, 3, :] = v.astype(np.uint32)  # big-endian int, bytes 12..16
+        out[:, L - 1, :] = 16  # every setK key is exactly 16 bytes
         return out
 
     n = n_batches
@@ -97,7 +103,8 @@ def main():
     from foundationdb_tpu.utils.knobs import KNOBS
 
     T = TXNS_PER_BATCH
-    shapes = ConflictShapes(capacity=CAPACITY, txns=T, reads=T, writes=T)
+    shapes = ConflictShapes(capacity=CAPACITY, txns=T, reads=T, writes=T,
+                            key_bytes=KEY_BYTES)
     scan = _compiled_scan(shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
     # pre-stage everything in HBM (untimed, like skipListTest's RAM test data)

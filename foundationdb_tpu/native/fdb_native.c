@@ -7,7 +7,7 @@
  * arbitrary-length byte keys into fixed-width uint32 limb arrays at millions
  * of keys/sec — far beyond what per-key Python can do. This module provides:
  *
- *   encode_keys_into(keys, out_buffer, n, round_up_mask)
+ *   encode_keys_into(keys, out_buffer, round_up[, key_bytes])
  *       bulk key -> limb encoding (layout matches utils/keys.py: KEY_BYTES
  *       prefix as big-endian u32 limbs + one length limb, SoA (L, N))
  *   crc32c(data, init) -> int
@@ -95,8 +95,15 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
     PyObject *keys;
     Py_buffer out;
     int round_up = 0;
-    if (!PyArg_ParseTuple(args, "Ow*|p", &keys, &out, &round_up))
+    int key_bytes = KEY_BYTES;
+    if (!PyArg_ParseTuple(args, "Ow*|pi", &keys, &out, &round_up, &key_bytes))
         return NULL;
+    if (key_bytes <= 0 || key_bytes > 64 || key_bytes % 4 != 0) {
+        PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "key_bytes must be in 4..64, /4");
+        return NULL;
+    }
+    int num_limbs = key_bytes / 4 + 1;
 
     PyObject *seq = PySequence_Fast(keys, "keys must be a sequence");
     if (!seq) {
@@ -104,7 +111,7 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
         return NULL;
     }
     Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-    if ((Py_ssize_t)(out.len) < (Py_ssize_t)(NUM_LIMBS * n * 4)) {
+    if ((Py_ssize_t)(out.len) < (Py_ssize_t)(num_limbs * n * 4)) {
         PyBuffer_Release(&out);
         Py_DECREF(seq);
         PyErr_SetString(PyExc_ValueError, "output buffer too small");
@@ -121,22 +128,22 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
             Py_DECREF(seq);
             return NULL;
         }
-        uint8_t padded[KEY_BYTES];
-        Py_ssize_t use = klen < KEY_BYTES ? klen : KEY_BYTES;
+        uint8_t padded[64];
+        Py_ssize_t use = klen < key_bytes ? klen : key_bytes;
         memcpy(padded, kbuf, use);
-        memset(padded + use, 0, KEY_BYTES - use);
-        for (int l = 0; l < NUM_LIMBS - 1; l++) {
+        memset(padded + use, 0, key_bytes - use);
+        for (int l = 0; l < num_limbs - 1; l++) {
             const uint8_t *p = padded + 4 * l;
             o[(Py_ssize_t)l * n + i] =
                 ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
                 ((uint32_t)p[2] << 8) | (uint32_t)p[3];
         }
         uint32_t lenlimb;
-        if (klen > KEY_BYTES)
-            lenlimb = round_up ? (KEY_BYTES + 1) : KEY_BYTES;
+        if (klen > key_bytes)
+            lenlimb = round_up ? ((uint32_t)key_bytes + 1) : (uint32_t)key_bytes;
         else
             lenlimb = (uint32_t)klen;
-        o[(Py_ssize_t)(NUM_LIMBS - 1) * n + i] = lenlimb;
+        o[(Py_ssize_t)(num_limbs - 1) * n + i] = lenlimb;
     }
     PyBuffer_Release(&out);
     Py_DECREF(seq);
@@ -147,7 +154,7 @@ static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
     {"encode_keys_into", py_encode_keys_into, METH_VARARGS,
-     "encode_keys_into(keys, out_u32_buffer, round_up=False)"},
+     "encode_keys_into(keys, out_u32_buffer, round_up=False, key_bytes=24)\nkey_bytes MUST match the buffer layout: out has key_bytes/4+1 limb rows."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {

@@ -51,25 +51,28 @@ from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 
-L = keylib.NUM_LIMBS  # key limbs (6 data + 1 length)
+L = keylib.NUM_LIMBS  # default key limbs (6 data + 1 length; see ConflictShapes.key_bytes)
 NEG = jnp.int32(-(1 << 30))  # "no version" sentinel, below any clamped offset
 _REBASE_THRESHOLD = 1 << 29
 
 
 def _bulk_encode(keys: list[bytes], out: np.ndarray, *, round_up: bool):
-    """Encode keys into out[:, :len(keys)] (SoA limbs), C path if built."""
+    """Encode keys into out[:, :len(keys)] (SoA limbs), C path if built.
+    The limb count (and so the key width) comes from `out`'s shape."""
     if not keys:
         return
     from foundationdb_tpu import native
 
+    nl = out.shape[0]
+    key_bytes = (nl - 1) * 4
     if native.available():
-        tmp = np.empty((L, len(keys)), dtype=np.uint32)
-        native.mod.encode_keys_into(keys, tmp, round_up)
+        tmp = np.empty((nl, len(keys)), dtype=np.uint32)
+        native.mod.encode_keys_into(keys, tmp, round_up, key_bytes)
         out[:, : len(keys)] = tmp
     else:
-        buf = np.zeros(L, dtype=np.uint32)
+        buf = np.zeros(nl, dtype=np.uint32)
         for i, k in enumerate(keys):
-            keylib.encode_key(k, buf, round_up=round_up)
+            keylib.encode_key(k, buf, round_up=round_up, key_bytes=key_bytes)
             out[:, i] = buf
 
 
@@ -81,7 +84,7 @@ def _key_lt(a, b):
     """a < b lexicographically; a, b are (L, ...) uint32."""
     lt = jnp.zeros(a.shape[1:], dtype=bool)
     eq = jnp.ones(a.shape[1:], dtype=bool)
-    for i in range(L):
+    for i in range(a.shape[0]):
         lt = lt | (eq & (a[i] < b[i]))
         eq = eq & (a[i] == b[i])
     return lt
@@ -89,7 +92,7 @@ def _key_lt(a, b):
 
 def _key_eq(a, b):
     eq = jnp.ones(a.shape[1:], dtype=bool)
-    for i in range(L):
+    for i in range(a.shape[0]):
         eq = eq & (a[i] == b[i])
     return eq
 
@@ -171,12 +174,23 @@ def _range_max(table, i0, i1):
 
 @dataclass(frozen=True)
 class ConflictShapes:
-    """Static shapes of one conflict batch (one XLA program per instance)."""
+    """Static shapes of one conflict batch (one XLA program per instance).
+
+    `key_bytes` sets the exact-comparison width (keys longer than it collapse
+    conservatively onto their prefix, utils/keys.py): compare cost on device
+    scales linearly with the limb count, so clusters with bounded keys run a
+    narrower engine — the reference's memcmp cost scales with key length the
+    same way (SkipList.cpp getCharacter/compare)."""
 
     capacity: int  # K: boundary slots in the step function
     txns: int  # T
     reads: int  # NR: total read ranges per batch (flattened)
     writes: int  # NW: total write ranges per batch
+    key_bytes: int = keylib.KEY_BYTES
+
+    @property
+    def limbs(self) -> int:
+        return self.key_bytes // 4 + 1
 
 
 def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
@@ -195,6 +209,7 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
       (False for all but the last chunk of a logical batch)
     """
     T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
+    L = shapes.limbs
     bkeys, bval, nb, oldest, table = (
         state["bkeys"], state["bval"], state["nb"], state["oldest"], state["table"])
     rb, re, rtxn = batch["rb"], batch["re"], batch["rtxn"]
@@ -308,6 +323,7 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
 def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
                  ablate=""):
     T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
+    L = shapes.limbs
     bkeys, bval, nb, oldest = (
         state["bkeys"], state["bval"], state["nb"], state["oldest"])
     wb, we, wtxn = batch["wb"], batch["we"], batch["wtxn"]
@@ -363,19 +379,18 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
                       jnp.uint32(0xFFFFFFFF))
     gdelta = jnp.zeros(CU + 1, jnp.int32).at[jnp.where(live, grp, CU)].add(
         jnp.where(live, sdelta, 0))[:CU]
-    # one fused bisection for both merge searches over the same queries:
-    # [upper bound (value lookup), lower bound (union position)]
-    mrg_q = jnp.concatenate([ukeys, ukeys], axis=1)
-    mrg_side = jnp.concatenate([jnp.ones(CU, bool), jnp.zeros(CU, bool)])
-    mrg_idx = _searchsorted(bkeys, mrg_q, mrg_side)
+    # ONE lower-bound bisection serves both merge searches: state keys are
+    # unique, so upper_bound = lb + dup, and the value lookup
+    # bval[max(ub-1, 0)] = bval[clip(lb - 1 + dup)] — this halves the
+    # merge's bisection queries (the single most expensive gather loop).
+    ia = _searchsorted(bkeys, ukeys, "left")  # first state key >= cand
+    dup = _key_eq(bkeys[:, jnp.minimum(ia, K - 1)], ukeys) & (ia < nb)
     # value of each unique candidate key under the current step function
-    uval = bval[jnp.maximum(mrg_idx[:CU] - 1, 0)]
+    uval = bval[jnp.clip(ia - 1 + dup.astype(jnp.int32), 0, K - 1)]
 
     # union-merge positions: state key i -> i + (#new-unique candidates < it);
     # candidate j -> (#state keys < it) + (#new-unique candidates before j).
     # A candidate equal to a state key maps to the SAME slot (no new slot).
-    ia = mrg_idx[CU:]  # first state key >= cand
-    dup = _key_eq(bkeys[:, jnp.minimum(ia, K - 1)], ukeys) & (ia < nb)
     is_new = ulive & ~dup
     pre = jnp.cumsum(is_new.astype(jnp.int32)) - is_new.astype(jnp.int32)
     pre_total = jnp.sum(is_new.astype(jnp.int32))
@@ -465,7 +480,7 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
     # pre-merge and remain exact.
     poisoned = state["poisoned"] | overflow
     pois_keys = jnp.broadcast_to(maxk, (L, K)).at[:, 0].set(
-        jnp.asarray(keylib.MIN_LIMBS, dtype=jnp.uint32))
+        jnp.zeros(L, dtype=jnp.uint32))  # encode(b"") == all-zero limbs
     pois_vals = jnp.full(K, NEG, jnp.int32).at[0].set(vnew)
     out_keys = jnp.where(poisoned, pois_keys, out_keys)
     out_vals = jnp.where(poisoned, pois_vals, out_vals)
@@ -501,8 +516,9 @@ def rebase_state(state: dict, delta: int):
 
 def init_state(shapes: ConflictShapes, oldest: int = 0):
     K = shapes.capacity
+    L = shapes.limbs
     maxk = np.full((L, K), 0xFFFFFFFF, dtype=np.uint32)
-    maxk[:, 0] = keylib.MIN_LIMBS  # segment 0: [b"", next) -> NEG
+    maxk[:, 0] = 0  # segment 0: [b"" (all-zero limbs), next) -> NEG
     bval = np.full(K, int(NEG), dtype=np.int32)
     return {
         "bkeys": jnp.asarray(maxk),
@@ -552,7 +568,7 @@ def _compiled_scan(shapes: ConflictShapes, max_write_life: int):
 
 
 def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
-                    writes_per_txn=None) -> ConflictShapes:
+                    writes_per_txn=None, key_bytes=None) -> ConflictShapes:
     k = KNOBS
     t = txns or k.CONFLICT_BATCH_TXNS
     return ConflictShapes(
@@ -560,6 +576,7 @@ def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
         txns=t,
         reads=t * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
         writes=t * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
+        key_bytes=key_bytes or keylib.KEY_BYTES,
     )
 
 
@@ -569,6 +586,7 @@ class BatchEncoder:
 
     def __init__(self, shapes: ConflictShapes, base_version: int = 0):
         self.shapes = shapes
+        self.L = shapes.limbs
         self.base_version = base_version
 
     def _clamp_off(self, version: int) -> int:
@@ -605,10 +623,10 @@ class BatchEncoder:
                 wkeys_e.append(e)
                 wt.append(t)
 
-        rb = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
-        re = np.full((L, sh.reads), 0xFFFFFFFF, np.uint32)
-        wb = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
-        we = np.full((L, sh.writes), 0xFFFFFFFF, np.uint32)
+        rb = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
+        re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
+        wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
+        we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
         _bulk_encode(rkeys_b, rb, round_up=False)
         _bulk_encode(rkeys_e, re, round_up=True)
         _bulk_encode(wkeys_b, wb, round_up=False)
@@ -691,8 +709,9 @@ class DeviceConflictSet:
 
     def __init__(self, capacity: int | None = None, txns: int | None = None,
                  reads_per_txn: int | None = None, writes_per_txn: int | None = None,
-                 oldest_version: int = 0):
-        self.shapes = _resolve_shapes(capacity, txns, reads_per_txn, writes_per_txn)
+                 oldest_version: int = 0, key_bytes: int | None = None):
+        self.shapes = _resolve_shapes(capacity, txns, reads_per_txn,
+                                      writes_per_txn, key_bytes)
         self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)

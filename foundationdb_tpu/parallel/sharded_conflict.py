@@ -44,6 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 shard_map = jax.shard_map
 
+from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.ops.batch import TOO_OLD, TxnConflictInfo
 from foundationdb_tpu.ops.conflict import (
     ConflictShapes, L, NEG, _REBASE_THRESHOLD, _key_lt, conflict_step,
@@ -96,13 +97,19 @@ def _clip_ranges(b, e, lo, hi):
     return b2, e2
 
 
-def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,
+def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
                           max_write_life: int):
     """Build the jitted SPMD step: (stacked_state, batch) -> (state', statuses, info).
 
     stacked_state: state pytree with a leading n_shards axis, sharded over the
     mesh; batch: replicated (same encoding as conflict_step's batch).
     """
+    if shapes.key_bytes != keylib.KEY_BYTES:
+        raise ValueError(
+            f"sharded engine only supports the default key width "
+            f"({keylib.KEY_BYTES}B); got key_bytes={shapes.key_bytes}. "
+            "Thread shapes.limbs through shard_cut_keys/_clip_ranges to "
+            "narrow it.")
     n = mesh.devices.size
     cuts = jnp.asarray(shard_cut_keys(n))  # (n+1, L) — baked constant
 

@@ -3,9 +3,11 @@
 FoundationDB keys are arbitrary byte strings ordered lexicographically
 (fdbclient/FDBTypes.h). A TPU kernel needs fixed shapes, so keys are encoded as
 ``NUM_LIMBS`` big-endian uint32 limbs covering the first ``KEY_BYTES`` bytes
-plus one length limb:
+plus one length limb (KEY_BYTES is the default width; every function here
+takes an explicit or buffer-inferred key_bytes, so engines can run narrower
+or wider — compare cost on device scales with the limb count):
 
-    encode(k) = (be32(k[0:4]), be32(k[4:8]), ..., min(len(k), KEY_BYTES))
+    encode(k) = (be32(k[0:4]), be32(k[4:8]), ..., min(len(k), key_bytes))
 
 Lexicographic comparison of the limb tuples equals byte-wise comparison of the
 keys, *exactly* for keys <= KEY_BYTES long. Longer keys collapse onto their
@@ -25,8 +27,17 @@ KEY_BYTES = 24
 NUM_LIMBS = KEY_BYTES // 4 + 1  # 6 data limbs + 1 length limb = 7
 
 
-def encode_key(key: bytes, out: np.ndarray | None = None, round_up: bool = False) -> np.ndarray:
-    """Encode one key to a (NUM_LIMBS,) uint32 vector.
+def num_limbs(key_bytes: int) -> int:
+    return key_bytes // 4 + 1
+
+
+def encode_key(key: bytes, out: np.ndarray | None = None, round_up: bool = False,
+               key_bytes: int | None = None) -> np.ndarray:
+    """Encode one key to a (num_limbs(key_bytes),) uint32 vector.
+
+    The width defaults to KEY_BYTES (24); passing `out` infers it from the
+    buffer as (len(out)-1)*4, and `key_bytes` overrides explicitly — narrow
+    engines (ConflictShapes.key_bytes) encode through the same function.
 
     A key longer than KEY_BYTES is not exactly representable; the encoding
     must round *conservatively* depending on which end of a half-open range
@@ -41,15 +52,18 @@ def encode_key(key: bytes, out: np.ndarray | None = None, round_up: bool = False
       would collapse to empty and a committed write would vanish from
       history: a false commit.
     """
+    if key_bytes is None:
+        key_bytes = KEY_BYTES if out is None else (len(out) - 1) * 4
+    nl = num_limbs(key_bytes)
     if out is None:
-        out = np.zeros(NUM_LIMBS, dtype=np.uint32)
-    k = key[:KEY_BYTES]
-    padded = k + b"\x00" * (KEY_BYTES - len(k))
-    out[: NUM_LIMBS - 1] = np.frombuffer(padded, dtype=">u4")
-    if len(key) > KEY_BYTES and round_up:
-        out[NUM_LIMBS - 1] = KEY_BYTES + 1
+        out = np.zeros(nl, dtype=np.uint32)
+    k = key[:key_bytes]
+    padded = k + b"\x00" * (key_bytes - len(k))
+    out[: nl - 1] = np.frombuffer(padded, dtype=">u4")
+    if len(key) > key_bytes and round_up:
+        out[nl - 1] = key_bytes + 1
     else:
-        out[NUM_LIMBS - 1] = min(len(key), KEY_BYTES)
+        out[nl - 1] = min(len(key), key_bytes)
     return out
 
 
@@ -65,9 +79,10 @@ def encode_keys(keys: list[bytes]) -> np.ndarray:
 
 
 def decode_key(limbs: np.ndarray) -> bytes:
-    """Inverse of encode_key for keys <= KEY_BYTES (used in tests)."""
-    length = int(limbs[NUM_LIMBS - 1])
-    raw = np.asarray(limbs[: NUM_LIMBS - 1], dtype=np.uint32).astype(">u4").tobytes()
+    """Inverse of encode_key for keys <= key width (used in tests)."""
+    nl = len(limbs)
+    length = int(limbs[nl - 1])
+    raw = np.asarray(limbs[: nl - 1], dtype=np.uint32).astype(">u4").tobytes()
     return raw[:length]
 
 

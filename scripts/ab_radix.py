@@ -1,0 +1,133 @@
+"""Microbench the radix-bucket conflict-state primitives on the real chip.
+
+Validates the cost model for the bucketed kernel before building it:
+  1. window gather: (Q,) bucket ids -> (Q, C, L) slot windows
+  2. per-bucket axis-1 sorting network: (B, C, L) sorted along C
+  3. 1D scatter-max of write tags
+  4. big-batch lax.sort baseline for candidate dedupe
+All inside lax.scan like the real kernel; sync via small fetch.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B = 131072  # buckets
+C = 16      # slots per bucket
+L = 5       # limbs for 16-byte keys (4 data + length)
+Q = 65536   # window queries per batch (2NR + 2NW at T=16384, 1+1 ranges)
+NW = 16384
+NB = 20
+
+rng = np.random.RandomState(0)
+slots = jnp.asarray(rng.randint(0, 1 << 31, size=(B, C, L)).astype(np.uint32))
+vals = jnp.asarray(rng.randint(0, 1 << 20, size=(B, C)).astype(np.int32))
+qb = jnp.asarray(rng.randint(0, B, size=(NB, Q)).astype(np.int32))
+wtag = jnp.asarray(rng.randint(0, B, size=(NB, NW)).astype(np.int32))
+cand = jnp.asarray(rng.randint(0, 1 << 31, size=(NB, 2 * NW, L + 1)).astype(np.uint32))
+
+
+def timed(name, fn, *args, n=3):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:24s} {min(ts) / NB * 1e3:8.3f} ms/batch")
+
+
+@jax.jit
+def window_gather(slots, vals, qb):
+    def step(acc, q):
+        w = slots[q]          # (Q, C, L)
+        v = vals[q]           # (Q, C)
+        return acc + jnp.sum(w[:, :, 0].astype(jnp.int32)) + jnp.sum(v), None
+    out, _ = lax.scan(step, jnp.int32(0), qb)
+    return out
+
+
+@jax.jit
+def window_gather_keysonly(slots, qb):
+    def step(acc, q):
+        w = slots[q]
+        return acc + jnp.sum(w[:, :, 0].astype(jnp.int32)), None
+    out, _ = lax.scan(step, jnp.int32(0), qb)
+    return out
+
+
+def cmpex(keys, i, j):
+    """Compare-exchange lanes i,j along axis 1, lexicographic on axis 2."""
+    a = keys[:, i, :]
+    b = keys[:, j, :]
+    lt = jnp.zeros(a.shape[0], bool)
+    eq = jnp.ones(a.shape[0], bool)
+    for l in range(L):
+        lt = lt | (eq & (b[:, l] < a[:, l]))
+        eq = eq & (a[:, l] == b[:, l])
+    swap = lt[:, None]
+    lo = jnp.where(swap, b, a)
+    hi = jnp.where(swap, a, b)
+    return keys.at[:, i, :].set(lo).at[:, j, :].set(hi)
+
+
+# Batcher odd-even merge network for 16 elements (63 CEs, 10 stages)
+def batcher16():
+    pairs = []
+    n = 16
+    p = 1
+    while p < n:
+        k = p
+        while k >= 1:
+            for j in range(k % p, n - k, 2 * k):
+                for i in range(0, min(k, n - j - k)):
+                    if (i + j) // (2 * p) == (i + j + k) // (2 * p):
+                        pairs.append((i + j, i + j + k))
+            k //= 2
+        p *= 2
+    return pairs
+
+
+PAIRS = batcher16()
+
+
+@jax.jit
+def bucket_sort(slots):
+    def step(acc, _):
+        s = slots
+        for i, j in PAIRS:
+            s = cmpex(s, i, j)
+        return acc + jnp.sum(s[:, 0, 0].astype(jnp.int32)), None
+    out, _ = lax.scan(step, jnp.int32(0), jnp.arange(NB))
+    return out
+
+
+@jax.jit
+def scatter_max(wtag):
+    def step(acc, t):
+        agg = jnp.full(B, -1, jnp.int32).at[t].max(t)
+        return acc + agg[0], None
+    out, _ = lax.scan(step, jnp.int32(0), wtag)
+    return out
+
+
+@jax.jit
+def cand_sort(cand):
+    def step(acc, c):
+        ops = [c[:, i] for i in range(L + 1)]
+        s = lax.sort(ops, num_keys=L)
+        return acc + s[0][0].astype(jnp.int32), None
+    out, _ = lax.scan(step, jnp.int32(0), cand)
+    return out
+
+
+print(f"B={B} C={C} L={L} Q={Q} NW={NW} ({len(PAIRS)} CEs in network)")
+timed("window gather k+v", window_gather, slots, vals, qb)
+timed("window gather keys", window_gather_keysonly, slots, qb)
+timed("bucket sort net", bucket_sort, slots)
+timed("scatter-max 1D", scatter_max, wtag)
+timed("cand sort 32k x6", cand_sort, cand)

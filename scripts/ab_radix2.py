@@ -1,0 +1,117 @@
+"""Round 2: pin down scatter rates and the unstacked sort network."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B = 393216
+C = 10
+L = 5
+NW = 16384
+NB = 20
+
+rng = np.random.RandomState(0)
+# unstacked slots: C arrays of (B, L) -> carried as one (C, B, L) but indexed
+# statically along axis 0 inside the kernel
+slots = [jnp.asarray(rng.randint(0, 1 << 31, size=(B, L)).astype(np.uint32))
+         for _ in range(C)]
+svals = [jnp.asarray(rng.randint(0, 1 << 20, size=B).astype(np.int32))
+         for _ in range(C)]
+idx = jnp.asarray(rng.randint(0, B * C, size=(NB, 2 * NW)).astype(np.int32))
+upd = jnp.asarray(rng.randint(0, 1 << 20, size=(NB, 2 * NW)).astype(np.int32))
+Q = 65536
+qb = jnp.asarray(rng.randint(0, B, size=(NB, Q)).astype(np.int32))
+
+
+def timed(name, fn, *args, n=3):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:28s} {min(ts) / NB * 1e3:8.3f} ms/batch")
+
+
+def mk_scatter(op):
+    flat0 = jnp.zeros(B * C, jnp.int32)
+
+    @jax.jit
+    def run(idx, upd):
+        def step(carry, iu):
+            i, u = iu
+            if op == "set":
+                carry = carry.at[i].set(u)
+            elif op == "add":
+                carry = carry.at[i].add(u)
+            else:
+                carry = carry.at[i].max(u)
+            return carry, None
+        out, _ = lax.scan(step, flat0, (idx, upd))
+        return out
+    return run
+
+
+@jax.jit
+def sortnet_unstacked(slots, svals):
+    """63-CE Batcher network over C static arrays (B, L): pure elementwise."""
+    def batcher(n):
+        pairs = []
+        p = 1
+        while p < n:
+            k = p
+            while k >= 1:
+                for j in range(k % p, n - k, 2 * k):
+                    for i in range(0, min(k, n - j - k)):
+                        if (i + j) // (2 * p) == (i + j + k) // (2 * p):
+                            pairs.append((i + j, i + j + k))
+                k //= 2
+            p *= 2
+        return pairs
+
+    def step(carry, _):
+        ks = list(carry[0])
+        vs = list(carry[1])
+        for i, j in batcher(C):
+            a, b = ks[i], ks[j]
+            va, vb = vs[i], vs[j]
+            lt = jnp.zeros(B, bool)
+            eq = jnp.ones(B, bool)
+            for l in range(L):
+                lt = lt | (eq & (b[:, l] < a[:, l]))
+                eq = eq & (a[:, l] == b[:, l])
+            sw = lt[:, None]
+            swv = lt
+            ks[i] = jnp.where(sw, b, a)
+            ks[j] = jnp.where(sw, a, b)
+            vs[i] = jnp.where(swv, vb, va)
+            vs[j] = jnp.where(swv, va, vb)
+        return (tuple(ks), tuple(vs)), None
+
+    out, _ = lax.scan(step, (tuple(slots), tuple(svals)), jnp.arange(NB))
+    return out[0][0]
+
+
+@jax.jit
+def windows_unstacked(slots, svals, qb):
+    """Window gather with unstacked layout: C gathers of (Q, L) each."""
+    def step(acc, q):
+        tot = acc
+        for c in range(C):
+            w = slots[c][q]          # (Q, L)
+            v = svals[c][q]          # (Q,)
+            tot = tot + jnp.sum(w[:, 0].astype(jnp.int32)) + jnp.sum(v)
+        return tot, None
+    out, _ = lax.scan(step, jnp.int32(0), qb)
+    return out
+
+
+timed("scatter set 32k->3.9M", mk_scatter("set"), idx, upd)
+timed("scatter add 32k->3.9M", mk_scatter("add"), idx, upd)
+timed("scatter max 32k->3.9M", mk_scatter("max"), idx, upd)
+timed("sortnet unstacked", sortnet_unstacked, slots, svals)
+timed("windows unstacked", windows_unstacked, slots, svals, qb)

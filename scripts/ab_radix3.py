@@ -1,0 +1,119 @@
+"""Round 3: sorted-index scatter rates + in-bucket bisect gather cost.
+
+If scatters with SORTED unique indices are fast (the classic kernel's merge
+uses them), the radix kernel's appends (also sorted by construction) are
+cheap, and the whole bucketed design clears. Also times the 4-step in-bucket
+bisection gather pattern and sort width scaling.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B = 1 << 18
+C = 12
+L = 5
+NW = 16384
+NB = 20
+OUT = B * C
+
+rng = np.random.RandomState(0)
+
+
+def timed(name, fn, *args, n=3):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:34s} {min(ts) / NB * 1e3:8.3f} ms/batch")
+
+
+# sorted unique indices, 2NW updates into B*C
+idx_sorted = np.sort(
+    rng.choice(OUT, size=(NB, 2 * NW), replace=False).astype(np.int32), axis=1)
+idx_rand = rng.randint(0, OUT, size=(NB, 2 * NW)).astype(np.int32)
+upd = rng.randint(0, 1 << 20, size=(NB, 2 * NW)).astype(np.int32)
+flat0 = jnp.zeros(OUT, jnp.int32)
+
+
+def mk_scatter(mode, idx):
+    idx = jnp.asarray(idx)
+    updj = jnp.asarray(upd)
+
+    @jax.jit
+    def run():
+        def step(carry, iu):
+            i, u = iu
+            if mode == "set":
+                carry = carry.at[i].set(u, unique_indices=True,
+                                        indices_are_sorted=True)
+            elif mode == "set_plain":
+                carry = carry.at[i].set(u)
+            elif mode == "add":
+                carry = carry.at[i].add(u, unique_indices=True,
+                                        indices_are_sorted=True)
+            else:
+                carry = carry.at[i].max(u, unique_indices=True,
+                                        indices_are_sorted=True)
+            return carry, None
+        out, _ = lax.scan(step, flat0, (idx, updj))
+        return out
+    return run
+
+
+# in-bucket bisect: per query, 4 steps of gathers from (B*C, ) limb arrays
+slots = [jnp.asarray(rng.randint(0, 1 << 31, size=OUT).astype(np.uint32))
+         for _ in range(L)]
+Q = 65536
+qb = jnp.asarray((rng.randint(0, B, size=(NB, Q)) * C).astype(np.int32))
+qk = jnp.asarray(rng.randint(0, 1 << 31, size=(NB, L, Q)).astype(np.uint32))
+
+
+@jax.jit
+def inbucket_bisect(qb, qk):
+    def step(acc, args):
+        base, q = args
+        lo = jnp.zeros(Q, jnp.int32)
+        hi = jnp.full(Q, C, jnp.int32)
+        for _ in range(4):
+            mid = (lo + hi) // 2
+            fl = base + jnp.minimum(mid, C - 1)
+            lt = jnp.zeros(Q, bool)
+            eq = jnp.ones(Q, bool)
+            for l in range(L):
+                m = slots[l][fl]
+                lt = lt | (eq & (m < q[l]))
+                eq = eq & (m == q[l])
+            go = lt & (lo < hi)
+            lo = jnp.where(go, mid + 1, lo)
+            hi = jnp.where(go, hi, mid)
+        return acc + jnp.sum(lo), None
+    out, _ = lax.scan(step, jnp.int32(0), (qb, qk))
+    return out
+
+
+# sort width scaling
+for wid in (32768, 65536):
+    c = jnp.asarray(rng.randint(0, 1 << 31,
+                                size=(NB, 8, wid)).astype(np.uint32))
+
+    @jax.jit
+    def srt(c=c, wid=wid):
+        def step(acc, row):
+            s = lax.sort([row[i] for i in range(8)], num_keys=5)
+            return acc + s[0][0].astype(jnp.int32), None
+        out, _ = lax.scan(step, jnp.int32(0), c)
+        return out
+    timed(f"sort {wid}x8 (5 keys)", srt)
+
+timed("scatter set sorted 32k->3.1M", mk_scatter("set", idx_sorted))
+timed("scatter set random 32k->3.1M", mk_scatter("set_plain", idx_rand))
+timed("scatter add sorted 32k->3.1M", mk_scatter("add", idx_sorted))
+timed("scatter max sorted 32k->3.1M", mk_scatter("max", idx_sorted))
+timed("in-bucket bisect 64k q x4 steps", inbucket_bisect, qb, qk)

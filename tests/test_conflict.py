@@ -334,3 +334,37 @@ def test_full_capacity_merge_above_all_boundaries():
                                           int(160).to_bytes(4, "big"))],
                             write_ranges=[])
     assert cs.detect([r_above, r_hit], version + 1) == [COMMITTED, CONFLICT]
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_randomized_parity_narrow_engine(seed):
+    """A key_bytes=16 engine (5 limbs — the width the reference's own
+    microbench keys need, SkipList.cpp setK 16-byte keys) must make decisions
+    identical to the oracle for keys within its exact width, including the
+    >16-byte conservative-collapse contract."""
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 500)
+    rng = DeterministicRandom(seed)
+    dev = small_device_set(key_bytes=16)
+    oracle = OracleConflictSet()
+    space = [b"............" + bytes([97 + i, 97 + j])  # setK-shaped 14B keys
+             for i in range(5) for j in range(5)]
+    version = 0
+    for _batch in range(20):
+        version += rng.randint(1, 300)
+        txns = [txn(max(0, version - rng.randint(0, 800)),
+                    [_random_range(rng, space) for _ in range(rng.randint(0, 3))],
+                    [_random_range(rng, space) for _ in range(rng.randint(0, 3))])
+                for _ in range(rng.randint(1, 30))]
+        check(dev, oracle, txns, version)
+
+
+def test_narrow_engine_long_key_collapse_is_conservative():
+    dev = small_device_set(key_bytes=16)
+    long_a = b"p" * 20 + b"AAAA"
+    long_b = b"p" * 20 + b"BBBB"  # distinct, same 16B prefix
+    assert dev.detect([txn(0, writes=[(long_a, long_a + b"\x00")])], 100) \
+        == [COMMITTED]
+    # reading the OTHER long key with a stale snapshot: collapsed prefix
+    # must conservatively conflict (never false-commit)
+    s = dev.detect([txn(50, reads=[(long_b, long_b + b"\x00")])], 200)
+    assert s == [CONFLICT]
