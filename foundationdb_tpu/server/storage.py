@@ -78,6 +78,14 @@ class StorageServer:
         # can move backwards on rollback, so re-delivered mutations from a new
         # epoch in (rollback_to, old_version] are re-fetched, not skipped.
         self._peek_begin = self.durable_version
+        # Highest version known fully acked across the log system (TLog peek
+        # replies carry it; the proxy stamps each TLogCommit with its
+        # committed_version). Durability must never pass it: versions beyond
+        # it can be rolled back by a recovery, and rollback below the durable
+        # engine is fatal (the reference's TLogPeekReply knownCommittedVersion
+        # serves exactly this role). Seeded from durable_version: it was
+        # bounded by known-committed before the reboot.
+        self._known_committed = self.durable_version
         self._pending_durable: deque[tuple[int, list]] = deque()
         self._watches: list[tuple[WatchValueRequest, object]] = []
         process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
@@ -89,6 +97,7 @@ class StorageServer:
         process.register(Token.STORAGE_ADD_SHARD, self._on_add_shard)
         process.register(Token.STORAGE_SET_SHARDS, self._on_set_shards)
         self._ingest_gate: object | None = None  # set while fetchKeys runs
+        self._ingest_idle: object | None = None  # update loop parked handshake
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
 
     def shutdown(self):
@@ -105,7 +114,30 @@ class StorageServer:
         # discard in-memory versions the new log system does not know; they
         # were never reported committed (the recovery version is min-durable
         # over a locked quorum, so every acked commit is <= rollback_to)
-        rollback_to = max(req.rollback_to, self.durable_version)
+        if req.rollback_to < self.durable_version:
+            # Never-acked data has already been made durable: possible when a
+            # long partition lets the durability cursor advance past versions
+            # the recovered quorum does not know (peeked from a TLog outside
+            # the locked quorum). Clamping would silently serve uncommitted
+            # data as committed; the reference treats rollback-past-durable
+            # as fatal for the storage server (it re-initializes from a clean
+            # fetch, storageserver.actor.cpp:2211 region). Kill THIS process
+            # (not the whole sim): the role stops serving its poisoned state
+            # and the cluster heals by re-replicating its shards. Should be
+            # unreachable now that durability is clamped by known_committed.
+            from foundationdb_tpu.core.sim import KillType
+            from foundationdb_tpu.utils.trace import TraceEvent
+            e = FDBError(
+                "internal_error",
+                f"rollback to {req.rollback_to} below durable version "
+                f"{self.durable_version}: storage server must be re-initialized")
+            TraceEvent("SSRollbackPastDurable", self.process.address) \
+                .detail("RollbackTo", req.rollback_to) \
+                .detail("Durable", self.durable_version).error(e).log()
+            reply.send_error(e)
+            self.process.net.kill(self.process.address, KillType.KillProcess)
+            return
+        rollback_to = req.rollback_to
         self.data.rollback(rollback_to)
         while self._pending_durable and self._pending_durable[-1][0] > rollback_to:
             self._pending_durable.pop()
@@ -177,9 +209,25 @@ class StorageServer:
         # mutations at versions <= fence may have been routed only to the
         # old team, so a snapshot below the fence would miss them here
         await self.version.when_at_least(req.fence_version)
+        if self._ingest_gate is not None:
+            # a second splice started while we awaited the fence; taking over
+            # its gate/idle futures would strand it forever — retry next round
+            reply.send_error(FDBError("operation_failed",
+                                      "fetchKeys already in progress"))
+            return
         gate = Future()
         self._ingest_gate = gate
+        # Handshake: wait until the update loop has actually PARKED on the
+        # gate. A peek already in flight when the gate was set would otherwise
+        # apply versions > c0 after the snapshot version is read, tripping
+        # VersionedMap's version-order guard and failing the splice round
+        # after round under sustained write load (a DD liveness defect). The
+        # loop signals idle at its top and discards any reply that raced the
+        # gate, so once idle resolves no version can advance until the gate
+        # lifts.
+        self._ingest_idle = Future()
         try:
+            await self._ingest_idle
             c0 = self.version.get()
             end = req.end if req.end is not None else b"\xff" * 40
             rows: list[tuple[bytes, bytes]] = []
@@ -202,6 +250,9 @@ class StorageServer:
             # already queued below C0.
             muts = [Mutation(MutationType.CLEAR_RANGE, req.begin, end)]
             muts += [Mutation(MutationType.SET_VALUE, k, v) for k, v in rows]
+            # the parked loop is the only writer, so this must still hold:
+            assert self.version.get() == c0, \
+                "ingestion advanced during a fetchKeys splice"
             for m in muts:
                 self.data.apply(c0, m)
             self._pending_durable.append((c0, muts))
@@ -212,6 +263,7 @@ class StorageServer:
             reply.send_error(e)
         finally:
             self._ingest_gate = None
+            self._ingest_idle = None
             gate._set(None)
 
     # -- ingestion (update :2358 + updateStorage :2633) --
@@ -220,7 +272,11 @@ class StorageServer:
         loop = self.process.net.loop
         while True:
             if self._ingest_gate is not None:
-                await self._ingest_gate  # fetchKeys splice in progress
+                # fetchKeys splice in progress: tell it we are parked (no
+                # apply can happen until the gate lifts), then wait
+                if self._ingest_idle is not None and not self._ingest_idle.is_ready():
+                    self._ingest_idle._set(None)
+                await self._ingest_gate
             epoch = self._epoch_for(self._peek_begin + 1)
             idx = self._peek_rotation % len(epoch.addrs)
             addr = epoch.addrs[idx]
@@ -246,6 +302,14 @@ class StorageServer:
                 # a rollback/rebind landed while this peek was in flight; the
                 # reply may carry the dead epoch's never-acked versions
                 continue
+            if self._ingest_gate is not None:
+                # a fetchKeys splice began while this peek was in flight:
+                # applying the reply now would advance versions past the
+                # splice's snapshot point. Discard (nothing was advanced;
+                # the range is re-peeked after the gate) and park at the top.
+                continue
+            self._known_committed = max(self._known_committed,
+                                        reply.known_committed_version)
             for version, muts in reply.messages:
                 if version <= self._peek_begin:
                     continue
@@ -277,8 +341,13 @@ class StorageServer:
         two only re-applies (idempotent) mutations."""
         # derive from the pull cursor, not self.version: after a rollback the
         # monotone version can exceed what has been re-fetched, and durability
-        # (and TLog pops!) must never pass unfetched mutations
-        target = self._peek_begin - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS
+        # (and TLog pops!) must never pass unfetched mutations. Clamp by the
+        # known-committed version: a single TLog's peeks advance the cursor
+        # through versions that were never fully acked, and making those
+        # durable would be unrecoverable when a recovery rolls them back
+        # (acked commits <= known_committed <= recovery_version).
+        target = min(self._peek_begin - KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS,
+                     self._known_committed)
         if target <= self.durable_version:
             return
         while self._pending_durable and self._pending_durable[0][0] <= target:

@@ -86,9 +86,9 @@ def decode_key(limbs: np.ndarray) -> bytes:
     return raw[:length]
 
 
-# Sentinels: the encoding of b"" (all zeros) is the minimal element; MAX_LIMBS
-# is strictly greater than any real key's encoding (length limb 0xFFFFFFFF).
-MIN_LIMBS = encode_key(b"")
+# Sentinel: strictly greater than any real key's encoding (length limb
+# 0xFFFFFFFF). The minimal element is the encoding of b"" — all-zero limbs —
+# which the conflict engine constructs inline where needed.
 MAX_LIMBS = np.full(NUM_LIMBS, 0xFFFFFFFF, dtype=np.uint32)
 
 

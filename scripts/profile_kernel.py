@@ -27,7 +27,7 @@ CAP = 1 << 18
 WINDOW = KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
 
 bench.TXNS_PER_BATCH = T
-shapes = C.ConflictShapes(capacity=CAP, txns=T, reads=T, writes=T)
+shapes = C.ConflictShapes(capacity=CAP, txns=T, reads=T, writes=T, key_bytes=16)
 
 
 def timed(name, fn, state, stacked, n=3):
