@@ -103,8 +103,10 @@ def main():
     from foundationdb_tpu.utils.knobs import KNOBS
 
     T = TXNS_PER_BATCH
+    # strided: 1 read + 1 write per txn, the skipListTest shape — the
+    # range->txn map compiles to reshapes instead of per-eval scatters
     shapes = ConflictShapes(capacity=CAPACITY, txns=T, reads=T, writes=T,
-                            key_bytes=KEY_BYTES)
+                            key_bytes=KEY_BYTES, strided=True)
     scan = _compiled_scan(shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
     # pre-stage everything in HBM (untimed, like skipListTest's RAM test data)

@@ -11,18 +11,21 @@ device-resident sorted boundary keys (fixed-width uint32 limbs) + per-segment
 version offsets + a sparse-table (power-of-two window) max pyramid — the dense
 analogue of the skiplist's per-level max-version annotations (:324-357).
 
-detect = ONE jitted function:
+detect = ONE jitted function built around ONE lax.sort of
+[state boundaries | read begins | read ends | write begins | write ends]
+(multi-limb binary searches lose to a single wide sort on TPU: each bisection
+step is a latency-bound multi-limb gather, while the sort runs at bandwidth):
   1. too-old filter (SkipList.cpp:985 semantics)
-  2. history check: vectorized binary search of every read range's endpoints
-     over the boundary array + O(1) sparse-table range-max, compare against
-     each txn's read snapshot (replaces CheckMax :755-837)
-  3. intra-batch: endpoint ranking by one lax.sort, pairwise read/write
-     overlap, txn-level dependency matrix, and an exact
-     lower/upper-bound fixpoint for "earlier txns win" semantics (replaces
-     MiniConflictSet :1028-1130; converges in <= chain-depth iterations,
-     each a tiny boolean mat-vec)
-  4. merge of surviving writes into the step function by sort/dedupe/coverage
-     prefix-sums (replaces mergeWriteConflictRanges :1260-1318)
+  2. history check: each read endpoint's rank among state boundaries comes
+     from the sort; O(1) sparse-table range-max over the segment versions,
+     compare against each txn's read snapshot (replaces CheckMax :755-837)
+  3. intra-batch: endpoint ranks from the same sort, pairwise read/write
+     overlap, and an exact lower/upper-bound fixpoint for "earlier txns win"
+     semantics (replaces MiniConflictSet :1028-1130; converges in <=
+     chain-depth iterations, each one int8 MXU mat-vec)
+  4. merge of surviving writes into the step function: the sorted array IS
+     the union; slots, coverage, and values are carved out with prefix scans
+     and one compaction scatter (replaces mergeWriteConflictRanges :1260)
   5. window GC by clamp + coalesce (replaces removeBefore :665)
 
 Versions on device are int32 *offsets* from a host-kept int64 base (the MVCC
@@ -54,6 +57,17 @@ from foundationdb_tpu.utils.knobs import KNOBS
 L = keylib.NUM_LIMBS  # default key limbs (6 data + 1 length; see ConflictShapes.key_bytes)
 NEG = jnp.int32(-(1 << 30))  # "no version" sentinel, below any clamped offset
 _REBASE_THRESHOLD = 1 << 29
+
+
+def _bulk_encode_at(keys: list[bytes], slots: list[int], out: np.ndarray, *,
+                    round_up: bool):
+    """Encode keys into out[:, slots[i]] (strided layout)."""
+    if not keys:
+        return
+    nl = out.shape[0]
+    tmp = np.empty((nl, len(keys)), dtype=np.uint32)
+    _bulk_encode(keys, tmp, round_up=round_up)
+    out[:, np.asarray(slots, dtype=np.int64)] = tmp[:, : len(keys)]
 
 
 def _bulk_encode(keys: list[bytes], out: np.ndarray, *, round_up: bool):
@@ -95,46 +109,6 @@ def _key_eq(a, b):
     for i in range(a.shape[0]):
         eq = eq & (a[i] == b[i])
     return eq
-
-
-def _searchsorted(bkeys, queries, side):
-    """Vectorized binary search over sorted multi-limb keys.
-
-    bkeys: (L, K) sorted ascending; queries: (L, Q).
-    side='left'  -> first index i with bkeys[:,i] >= q (lower bound)
-    side='right' -> first index i with bkeys[:,i] >  q (upper bound)
-    side may also be a (Q,) bool array: True = 'right' for that query,
-    letting several logical searches share one unrolled bisection.
-
-    The bisection is UNROLLED (static step count): a lax loop here costs a
-    device-visible sync per iteration, which profiling showed dominating the
-    whole conflict step.
-    """
-    K = bkeys.shape[1]
-    Q = queries.shape[1]
-    lo = jnp.zeros(Q, dtype=jnp.int32)
-    hi = jnp.full(Q, K, dtype=jnp.int32)
-    steps = max(1, int(np.ceil(np.log2(max(K, 2)))) + 1)
-
-    for _ in range(steps):
-        mid = (lo + hi) // 2
-        midkeys = bkeys[:, mid]  # (L, Q) gather
-        if isinstance(side, str):
-            if side == "left":
-                go_right = _key_lt(midkeys, queries)
-            else:
-                go_right = ~_key_lt(queries, midkeys)  # midkeys <= q
-        else:
-            go_right = jnp.where(side, ~_key_lt(queries, midkeys),
-                                 _key_lt(midkeys, queries))
-        # once converged (lo == hi) the interval is empty: without this guard
-        # a surplus unrolled step at lo == hi == K gathers the clamped last
-        # key and can push lo to K+1 for queries above every stored key,
-        # which the merge's slot arithmetic would consume unclamped
-        go_right = go_right & (lo < hi)
-        lo = jnp.where(go_right, mid + 1, lo)
-        hi = jnp.where(go_right, hi, mid)
-    return lo
 
 
 # ---------------------------------------------------------------------------
@@ -187,10 +161,40 @@ class ConflictShapes:
     reads: int  # NR: total read ranges per batch (flattened)
     writes: int  # NW: total write ranges per batch
     key_bytes: int = keylib.KEY_BYTES
+    # strided=True fixes the range->txn map at TRACE time: read slot j
+    # belongs to txn j // (reads//txns), write slot j to txn j // (writes//
+    # txns); unused slots are padded with empty ranges. Every per-txn fold
+    # (blocked reads -> txn, has_reads, commit -> writes) then compiles to a
+    # reshape-reduce instead of a data-dependent scatter/gather — the
+    # scatters cost ~0.5ms each on TPU and the intra-batch fixpoint pays one
+    # PER EVALUATION. Requires every txn to fit the stride (the encoder
+    # rejects oversized txns); the dynamic layout remains the default.
+    strided: bool = False
+
+    def __post_init__(self):
+        if self.key_bytes % 4 or not 4 <= self.key_bytes <= 64:
+            raise ValueError(
+                f"key_bytes must be a multiple of 4 in [4, 64], got "
+                f"{self.key_bytes} (the limb encoding is 4 bytes wide and "
+                f"the native encoder caps at 64)")
+        if self.strided and (self.reads % self.txns or self.writes % self.txns):
+            raise ValueError("strided layout needs reads/writes divisible by txns")
 
     @property
     def limbs(self) -> int:
         return self.key_bytes // 4 + 1
+
+
+def _carry_last_flagged(values, flags):
+    """At each position: `values` at the most recent position with flags=True
+    (inclusive), or the dtype value passed at unflagged position 0 if none yet.
+    One associative scan (the 'last valid' monoid)."""
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+    out, _ = lax.associative_scan(op, (values, flags))
+    return out
 
 
 def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
@@ -207,6 +211,15 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
       commit_version () i32 offset
       advance_floor () bool — advance the MVCC window after this chunk
       (False for all but the last chunk of a logical batch)
+
+    Layout: ONE lax.sort of [state boundaries | rb | re | wb | we] per step
+    feeds everything — history positions (instead of a 19-step multi-limb
+    bisection whose per-step gathers dominated the profile), intra-batch
+    endpoint ranks (instead of a second sort), and the merged union of state
+    with committed write endpoints (instead of a second bisection plus a
+    scatter-built union). On TPU a 330k-wide multi-operand sort costs ~2ms
+    while each bisection costs ~6.4ms in gathers, so the sort is the cheapest
+    way to position queries in the state.
     """
     T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
     L = shapes.limbs
@@ -217,9 +230,43 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     snapshot, txn_valid = batch["snapshot"], batch["txn_valid"]
     vnew = batch["commit_version"]
 
-    rvalid = rtxn < T
-    wvalid = wtxn < T
-    has_reads = (jnp.zeros(T + 1, bool).at[rtxn].max(rvalid))[:T]
+    if shapes.strided:
+        # slot validity from the key itself: real keys never carry the
+        # 0xFFFFFFFF length limb the padding uses, so empty-but-real ranges
+        # (b == e) still count as "has reads" for the too-old rule
+        rvalid = rb[L - 1] != jnp.uint32(0xFFFFFFFF)
+        wvalid = wb[L - 1] != jnp.uint32(0xFFFFFFFF)
+        has_reads = rvalid.reshape(T, NR // T).any(axis=1)
+    else:
+        rvalid = rtxn < T
+        wvalid = wtxn < T
+        has_reads = (jnp.zeros(T + 1, bool).at[rtxn].max(rvalid))[:T]
+
+    # ---- 0. THE sort: [state | rb | re | wb | we] ----
+    # Class tiebreak at equal keys: re(0) < state(1) < rb/wb/we(2).
+    #  - rb after equal state keys  -> #state<=rb = upper bound (segment of rb)
+    #  - re before equal state keys -> #state<re  = lower bound
+    #  - wb/we after equal state keys -> duplicate endpoint lands in the SAME
+    #    union slot as the state boundary it equals
+    N_ALL = K + 2 * NR + 2 * NW
+    allk = jnp.concatenate([bkeys, rb, re, wb, we], axis=1)  # (L, N_ALL)
+    cls = jnp.concatenate([
+        jnp.ones(K, jnp.int32),
+        jnp.full(NR, 2, jnp.int32), jnp.zeros(NR, jnp.int32),
+        jnp.full(2 * NW, 2, jnp.int32)])
+    vpay = jnp.concatenate([bval, jnp.full(2 * NR + 2 * NW, NEG, jnp.int32)])
+    sort_ops = [allk[i] for i in range(L)] + [
+        cls, vpay, jnp.arange(N_ALL, dtype=jnp.int32)]
+    sorted_ops = lax.sort(sort_ops, num_keys=L + 1)
+    skeys = jnp.stack(sorted_ops[:L])       # (L, N_ALL) sorted
+    scls = sorted_ops[L]
+    sval = sorted_ops[L + 1]                # state values in sorted order
+    sidx = sorted_ops[L + 2]                # original element index
+    # inverse permutation: sorted position of each original element
+    spos = jnp.zeros(N_ALL, jnp.int32).at[sidx].set(
+        jnp.arange(N_ALL, dtype=jnp.int32))
+    is_state = scls == 1
+    cum_state = jnp.cumsum(is_state.astype(jnp.int32))  # inclusive
 
     # ---- 1. too-old (only txns with read ranges expire: SkipList.cpp:985) ----
     too_old = txn_valid & has_reads & (snapshot < oldest)
@@ -228,18 +275,19 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     if ablate in ("no_hist", "only_merge"):
         hist_conflict = jnp.zeros(T, bool)
     else:
-        # one fused bisection: [rb -> upper bound, re -> lower bound]
-        hist_q = jnp.concatenate([rb, re], axis=1)
-        hist_side = jnp.concatenate([jnp.ones(NR, bool), jnp.zeros(NR, bool)])
-        hist_idx = _searchsorted(bkeys, hist_q, hist_side)
-        i0 = hist_idx[:NR] - 1  # segment containing begin
-        i1 = hist_idx[NR:]  # first boundary >= end
-        i0 = jnp.maximum(i0, 0)
+        ub_rb = cum_state[spos[K:K + NR]]        # #state keys <= rb
+        lb_re = cum_state[spos[K + NR:K + 2 * NR]]  # #state keys < re
+        i0 = jnp.maximum(ub_rb - 1, 0)  # segment containing begin
+        i1 = lb_re  # first boundary >= end
         nonempty = _key_lt(rb, re)
         maxver = _range_max(table, i0, jnp.maximum(i1, i0 + 1))
-        rsnap = snapshot[jnp.minimum(rtxn, T - 1)]
+        rsnap = (jnp.repeat(snapshot, NR // T) if shapes.strided
+                 else snapshot[jnp.minimum(rtxn, T - 1)])
         read_hits = rvalid & nonempty & (maxver > rsnap)
-        hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
+        if shapes.strided:
+            hist_conflict = read_hits.reshape(T, NR // T).any(axis=1)
+        else:
+            hist_conflict = (jnp.zeros(T + 1, bool).at[rtxn].max(read_hits))[:T]
 
     g0 = txn_valid & ~too_old & ~hist_conflict
     if ablate in ("no_intra", "only_merge", "only_hist"):
@@ -249,46 +297,62 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
             jnp.where(too_old, TOO_OLD, CONFLICT)).astype(jnp.int32)
         statuses = jnp.where(txn_valid, statuses, COMMITTED)
         return _merge_phase(state, batch, statuses, commit, shapes,
-                            max_write_life, ablate)
+                            max_write_life, ablate, sort_products=(
+                                skeys, scls, sval, sidx, spos, cum_state))
     # ---- 3. intra-batch: endpoint ranks -> pairwise overlap -> fixpoint ----
     # The (T,T) dependency matrix of the first design required a 2D scatter
     # (~170ms/batch on TPU); instead the fixpoint operates directly on the
     # (NW, NR) range-overlap matrix via an MXU matvec: committed writes ->
     # blocked reads is one bf16 matmul with exact f32 accumulation (0/1
     # values), then a cheap 1D segment-max folds reads back to transactions.
-    allk = jnp.concatenate([rb, re, wb, we], axis=1)  # (L, NA)
-    NA = 2 * NR + 2 * NW
-    ops = [allk[i] for i in range(L)] + [jnp.arange(NA, dtype=jnp.int32)]
-    sorted_ops = lax.sort(ops, num_keys=L)
-    perm = sorted_ops[L]
-    skeys = jnp.stack(sorted_ops[:L])
+    # Endpoint ranks come from the big sort: rank = number of distinct
+    # batch-endpoint key groups at-or-before this element, which is
+    # order-isomorphic to the keys over batch endpoints (state elements
+    # interleave but contribute no rank).
+    is_batch = ~is_state
     newgrp = jnp.concatenate(
         [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])])
-    rank_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
-    ranks = jnp.zeros(NA, jnp.int32).at[perm].set(rank_sorted)
-    rbr, rer = ranks[:NR], ranks[NR:2 * NR]
-    wbr, wer = ranks[2 * NR:2 * NR + NW], ranks[2 * NR + NW:]
+    cum_b_excl = jnp.cumsum(is_batch.astype(jnp.int32)) - is_batch
+    grp_start_b = lax.cummax(jnp.where(newgrp, cum_b_excl, -1))
+    first_b = is_batch & (cum_b_excl == grp_start_b)
+    rank_grp = jnp.cumsum(first_b.astype(jnp.int32)) - 1
+    # carry each group's first-batch rank forward (monotone -> cummax)
+    rank_carried = lax.cummax(jnp.where(first_b, rank_grp, -1))
+    qranks = rank_carried[spos[K:]]          # ranks of [rb | re | wb | we]
+    rbr, rer = qranks[:NR], qranks[NR:2 * NR]
+    wbr, wer = qranks[2 * NR:2 * NR + NW], qranks[2 * NR + NW:]
 
     # empty/inverted ranges (end <= begin) participate in neither side;
     # strict wtxn < rtxn = "earlier txns win" (checkIntraBatchConflicts
     # SkipList.cpp:1139-1152 processes in batch order)
     r_nonempty = rbr < rer
     w_nonempty = wbr < wer
+    if shapes.strided:
+        order_ok = ((jnp.arange(NW, dtype=jnp.int32) // (NW // T))[:, None]
+                    < (jnp.arange(NR, dtype=jnp.int32) // (NR // T))[None, :])
+    else:
+        order_ok = wtxn[:, None] < rtxn[None, :]
     overlap = ((wbr[:, None] < rer[None, :]) & (rbr[None, :] < wer[:, None])
                & (wvalid & w_nonempty)[:, None] & (rvalid & r_nonempty)[None, :]
-               & (wtxn[:, None] < rtxn[None, :]))  # (NW, NR)
-    ovf = overlap.astype(jnp.bfloat16)
+               & order_ok)  # (NW, NR)
+    # int8 halves the fixpoint's HBM traffic vs bf16 (the matrix read
+    # dominates each matvec); int8 x int8 -> int32 runs natively on the MXU
+    ovf = overlap.astype(jnp.int8)
     g = txn_valid & ~too_old & ~hist_conflict
     wtxn_c = jnp.minimum(wtxn, T - 1)
 
     def _f_commit(c):
         """f(c)[t] = g[t] and no committed-in-c earlier txn's write overlaps
         any of t's reads."""
-        cw = (c[wtxn_c] & wvalid).astype(jnp.bfloat16)
+        cm = jnp.repeat(c, NW // T) if shapes.strided else c[wtxn_c]
+        cw = (cm & wvalid).astype(jnp.int8)
         blocked_r = lax.dot_general(
             cw[None, :], ovf, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)[0] > 0
-        blocked_t = (jnp.zeros(T + 1, bool).at[rtxn].max(blocked_r))[:T]
+            preferred_element_type=jnp.int32)[0] > 0
+        if shapes.strided:
+            blocked_t = blocked_r.reshape(T, NR // T).any(axis=1)
+        else:
+            blocked_t = (jnp.zeros(T + 1, bool).at[rtxn].max(blocked_r))[:T]
         return g & ~blocked_t
 
     upper = g
@@ -304,11 +368,10 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
         lower2 = _f_commit(upper2)
         return lower2, upper2
 
-    # typical dependency chains are shallow: unroll the first sandwich rounds
+    # typical dependency chains are shallow: unroll the first sandwich round
     # (each device-loop iteration costs a sync) and fall back to the loop only
     # for adversarially deep chains
-    for _ in range(2):
-        lower, upper = body((lower, upper))
+    lower, upper = body((lower, upper))
     lower, upper = lax.while_loop(cond, body, (lower, upper))
     commit = lower
 
@@ -317,11 +380,12 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
         jnp.where(too_old, TOO_OLD, CONFLICT)).astype(jnp.int32)
     statuses = jnp.where(txn_valid, statuses, COMMITTED)
     return _merge_phase(state, batch, statuses, commit, shapes,
-                        max_write_life, ablate)
+                        max_write_life, ablate, sort_products=(
+                            skeys, scls, sval, sidx, spos, cum_state))
 
 
 def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
-                 ablate=""):
+                 ablate="", sort_products=None):
     T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
     L = shapes.limbs
     bkeys, bval, nb, oldest = (
@@ -341,98 +405,59 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
         return new_state, statuses, info
 
     # ---- 4. merge surviving writes into the step function at vnew ----
-    # Incremental: only the 2NW candidate endpoints are sorted (the state's K
-    # boundaries are already sorted); the union is built by binary-searching
-    # each side into the other and scattering to merged positions. This
-    # replaces the original design's three full (K+2NW)-wide multi-limb sorts
-    # per batch with one 2NW-wide sort — the device analogue of the
-    # reference's finger-merge (mergeWriteConflictRanges SkipList.cpp:1260,
-    # which also only walks the *new* ranges).
+    # The union of state boundaries and committed write endpoints is already
+    # IN the big sorted array (sort_products); dead elements — read
+    # endpoints, uncommitted/empty writes, dead state slots — are simply not
+    # union slots, and the merged state is carved out with prefix scans + one
+    # compaction scatter. This replaces the previous incremental design's
+    # per-batch multi-limb bisection of candidates into the state (the single
+    # most expensive gather loop) with sort products that history and
+    # intra-batch checks already paid for (the device analogue of the
+    # reference's finger-merge, mergeWriteConflictRanges SkipList.cpp:1260).
+    skeys, scls, sval, sidx, spos, cum_state = sort_products
+    N_ALL = K + 2 * NR + 2 * NW
+    if shapes.strided:
+        wvalid = wb[L - 1] != jnp.uint32(0xFFFFFFFF)
+        commit_w = jnp.repeat(commit, NW // T)
+    else:
+        commit_w = commit[wtxn_c]
     # committed, non-empty writes only: an inverted range would inject a
     # reversed -1/+1 coverage delta and cancel other writes' coverage
-    cw = wvalid & commit[wtxn_c] & _key_lt(wb, we)
-    CU = 2 * NW
-    maxk = jnp.full((L, 1), jnp.uint32(0xFFFFFFFF))
-    cand = jnp.concatenate([wb, we], axis=1)  # (L, CU)
-    cand_valid = jnp.concatenate([cw, cw])
-    cand = jnp.where(cand_valid[None, :], cand, maxk)
-    # delta for coverage counting: +1 at committed write begins, -1 at ends
-    cand_delta = jnp.concatenate(
-        [cw.astype(jnp.int32), -(cw.astype(jnp.int32))])
+    cw = wvalid & commit_w & _key_lt(wb, we)
+    # coverage deltas at each write endpoint's sorted position: +1 at
+    # committed begins, -1 at committed ends (positions are unique)
+    delta_w = jnp.concatenate([cw.astype(jnp.int32), -(cw.astype(jnp.int32))])
+    pos_w = spos[K + 2 * NR:]
+    delta_sorted = jnp.zeros(N_ALL, jnp.int32).at[pos_w].set(delta_w)
 
-    # sort candidates (dead ones carry delta 0 and key maxk -> sort last)
-    s = lax.sort([cand[i] for i in range(L)] + [cand_delta], num_keys=L)
-    skeys = jnp.stack(s[:L])
-    sdelta = s[L]
-    live = sdelta != 0
-    first = jnp.concatenate(
-        [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])]) & live
-    grp = jnp.cumsum(first.astype(jnp.int32)) - 1  # unique-key rank
-    mc = jnp.sum(first.astype(jnp.int32))  # number of unique candidate keys
-    # unique representatives packed to ranks [0, mc); others -> dump slot CU.
-    # One int32 scatter + a gather instead of scattering the (L, .) limbs.
-    pos_rep = jnp.where(first, grp, CU)
-    rep_src = jnp.full(CU + 1, CU - 1, jnp.int32).at[pos_rep].set(
-        jnp.arange(CU, dtype=jnp.int32))[:CU]
-    ulive = jnp.arange(CU) < mc
-    ukeys = jnp.where(ulive[None, :], skeys[:, rep_src],
-                      jnp.uint32(0xFFFFFFFF))
-    gdelta = jnp.zeros(CU + 1, jnp.int32).at[jnp.where(live, grp, CU)].add(
-        jnp.where(live, sdelta, 0))[:CU]
-    # ONE lower-bound bisection serves both merge searches: state keys are
-    # unique, so upper_bound = lb + dup, and the value lookup
-    # bval[max(ub-1, 0)] = bval[clip(lb - 1 + dup)] — this halves the
-    # merge's bisection queries (the single most expensive gather loop).
-    ia = _searchsorted(bkeys, ukeys, "left")  # first state key >= cand
-    dup = _key_eq(bkeys[:, jnp.minimum(ia, K - 1)], ukeys) & (ia < nb)
-    # value of each unique candidate key under the current step function
-    uval = bval[jnp.clip(ia - 1 + dup.astype(jnp.int32), 0, K - 1)]
+    # union slot sources: live state boundaries + committed write endpoints
+    is_state = scls == 1
+    live_state = is_state & (sidx < nb)
+    is_src = live_state | (delta_sorted != 0)
+    # one representative (slot) per distinct key among sources; the class
+    # tiebreak sorted state before equal write endpoints, so a duplicate
+    # endpoint joins the state boundary's slot
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])])
+    cum_src_excl = jnp.cumsum(is_src.astype(jnp.int32)) - is_src
+    grp_start_src = lax.cummax(jnp.where(newgrp, cum_src_excl, -1))
+    rep = is_src & (cum_src_excl == grp_start_src)
 
-    # union-merge positions: state key i -> i + (#new-unique candidates < it);
-    # candidate j -> (#state keys < it) + (#new-unique candidates before j).
-    # A candidate equal to a state key maps to the SAME slot (no new slot).
-    is_new = ulive & ~dup
-    pre = jnp.cumsum(is_new.astype(jnp.int32)) - is_new.astype(jnp.int32)
-    pre_total = jnp.sum(is_new.astype(jnp.int32))
-    # new-unique candidates preceding each state key, WITHOUT a second binary
-    # search (K queries over the candidates would gather (L,K) per bisection
-    # step) and without a (K,)-wide gather: a new-unique candidate j is
-    # strictly below exactly the state keys i >= ia[j] (new means not equal
-    # to any state key), so a scatter-add at ia[j] followed by a prefix sum
-    # gives each state key's slot shift.
-    dmark = jnp.zeros(K + 1, jnp.int32).at[
-        jnp.where(is_new, ia, K)].add(jnp.where(is_new, 1, 0))
-    slotA = jnp.arange(K) + jnp.cumsum(dmark)[:K]
-    slotB = ia + pre
-    nu = nb + pre_total  # union size
-    KU = K + CU  # + 1 dump slot
+    # value of each slot under the CURRENT step function: the last live state
+    # boundary's value at-or-before it, carried forward by scan (sorted-order
+    # values rode the sort as a payload operand; an N_ALL-wide scan is
+    # cheaper than the random bval gather it replaces)
+    val_u = _carry_last_flagged(jnp.where(live_state, sval, NEG), live_state)
 
-    # Build the union via ONE int32 source-index scatter + gathers: scattering
-    # the (L, ...) key limbs directly costs L scatter rows, while gathers of
-    # the same shape are cheap on TPU.
-    liveA = jnp.arange(K) < nb
-    posA = jnp.where(liveA, slotA, KU)
-    posB = jnp.where(ulive, slotB, KU)
-    src = jnp.full(KU + 1, -1, jnp.int32)
-    src = src.at[posA].set(jnp.arange(K, dtype=jnp.int32))
-    # B written second: a dup slot resolves to its candidate (same key; the
-    # candidate carries the coverage delta and an identical value)
-    src = src.at[posB].set(K + jnp.arange(CU, dtype=jnp.int32))
-    is_b = src >= K
-    src_c = jnp.clip(src, 0, K + CU - 1)
-    # one fused value/delta lookup over a concatenated [state | candidate]
-    # table instead of two separate per-source gathers + select
-    vtab = jnp.concatenate([bval, uval])
-    dtab = jnp.concatenate([jnp.zeros(K, jnp.int32), gdelta])
-    val_u = jnp.where(src >= 0, vtab[src_c], NEG)
-    delta_u = jnp.where(is_b, dtab[src_c], 0)
-
-    # coverage: prefix-sum of deltas in key order; >0 => segment covered by a
-    # committed write of this batch, so its version becomes vnew
-    cover = jnp.cumsum(delta_u) > 0
-    idxu = jnp.arange(KU + 1)
-    live_u = idxu < nu
-    newval = jnp.where(cover & live_u, jnp.maximum(val_u, vnew), val_u)
+    # coverage at a slot = total delta through the END of its key group
+    # (within a group the +1/-1 order is arbitrary; at the group end it has
+    # settled). Backward-carry the group-end prefix sum to every member.
+    csum_delta = jnp.cumsum(delta_sorted)
+    grp_last = jnp.concatenate([newgrp[1:], jnp.ones(1, bool)])
+    cover_cnt = jnp.flip(_carry_last_flagged(
+        jnp.flip(jnp.where(grp_last, csum_delta, 0)), jnp.flip(grp_last)))
+    cover = cover_cnt > 0
+    newval = jnp.where(cover, jnp.maximum(val_u, vnew), val_u)
 
     # ---- 5. window GC: clamp to new floor + coalesce equal neighbors ----
     # advance_floor is False for all but the last chunk of a logical batch:
@@ -442,30 +467,26 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
     floor = jnp.where(batch["advance_floor"],
                       vnew - jnp.int32(max_write_life), oldest)
     new_oldest = jnp.maximum(oldest, floor)
-    newval = jnp.where(live_u, jnp.maximum(newval, new_oldest), NEG)
+    newval = jnp.maximum(newval, new_oldest)
 
     # coalesce (removeBefore's segment-merge analogue): a slot is redundant
-    # if its value equals its predecessor's post-clamp value
-    prev_val = jnp.concatenate([jnp.full(1, NEG, jnp.int32), newval[:-1]])
-    keep2 = live_u & ((idxu == 0) | (newval != prev_val))
+    # if its value equals its predecessor slot's post-clamp value
+    cum_rep = jnp.cumsum(rep.astype(jnp.int32))
+    rep_val_carried = _carry_last_flagged(jnp.where(rep, newval, NEG), rep)
+    prev_rep_val = jnp.concatenate(
+        [jnp.full(1, NEG, jnp.int32), rep_val_carried[:-1]])
+    keep2 = rep & ((cum_rep == 1) | (newval != prev_rep_val))
     n2 = jnp.sum(keep2.astype(jnp.int32))
     # compact kept slots to the front: one int32 source scatter, then gather
-    # keys directly from their ORIGINAL arrays (state / unique candidates)
-    # through the composed index — the union's key array is never
-    # materialized at all.
+    # keys/values from the sorted arrays (indices are monotone)
     cpos = jnp.cumsum(keep2.astype(jnp.int32)) - 1
     cpos = jnp.where(keep2, jnp.minimum(cpos, K - 1), K)
     csrc = jnp.full(K + 1, -1, jnp.int32).at[cpos].set(
-        jnp.arange(KU + 1, dtype=jnp.int32))[:K]
+        jnp.arange(N_ALL, dtype=jnp.int32))[:K]
     kept = csrc >= 0
-    csrc_c = jnp.clip(csrc, 0, KU)
-    fsrc = src[csrc_c]  # source id of each final slot (composed)
-    f_is_a = kept & (fsrc >= 0) & (fsrc < K)
-    f_is_b = kept & (fsrc >= K)
-    out_keys = jnp.where(
-        f_is_a[None, :], bkeys[:, jnp.clip(fsrc, 0, K - 1)],
-        jnp.where(f_is_b[None, :], ukeys[:, jnp.clip(fsrc - K, 0, CU - 1)],
-                  jnp.uint32(0xFFFFFFFF)))
+    csrc_c = jnp.clip(csrc, 0, N_ALL - 1)
+    out_keys = jnp.where(kept[None, :], skeys[:, csrc_c],
+                         jnp.uint32(0xFFFFFFFF))
     out_vals = jnp.where(kept, newval[csrc_c], NEG)
 
     overflow = n2 > K
@@ -479,7 +500,7 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
     # semantics, SkipList.cpp:957). This batch's own statuses are computed
     # pre-merge and remain exact.
     poisoned = state["poisoned"] | overflow
-    pois_keys = jnp.broadcast_to(maxk, (L, K)).at[:, 0].set(
+    pois_keys = jnp.full((L, K), jnp.uint32(0xFFFFFFFF)).at[:, 0].set(
         jnp.zeros(L, dtype=jnp.uint32))  # encode(b"") == all-zero limbs
     pois_vals = jnp.full(K, NEG, jnp.int32).at[0].set(vnew)
     out_keys = jnp.where(poisoned, pois_keys, out_keys)
@@ -568,7 +589,8 @@ def _compiled_scan(shapes: ConflictShapes, max_write_life: int):
 
 
 def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
-                    writes_per_txn=None, key_bytes=None) -> ConflictShapes:
+                    writes_per_txn=None, key_bytes=None,
+                    strided=False) -> ConflictShapes:
     k = KNOBS
     t = txns or k.CONFLICT_BATCH_TXNS
     return ConflictShapes(
@@ -577,6 +599,7 @@ def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
         reads=t * (reads_per_txn or k.CONFLICT_BATCH_READS_PER_TXN),
         writes=t * (writes_per_txn or k.CONFLICT_BATCH_WRITES_PER_TXN),
         key_bytes=key_bytes or keylib.KEY_BYTES,
+        strided=strided,
     )
 
 
@@ -588,6 +611,13 @@ class BatchEncoder:
         self.shapes = shapes
         self.L = shapes.limbs
         self.base_version = base_version
+        if shapes.strided:
+            self._strided_rtxn = jnp.asarray(
+                np.arange(shapes.reads, dtype=np.int32)
+                // (shapes.reads // shapes.txns))
+            self._strided_wtxn = jnp.asarray(
+                np.arange(shapes.writes, dtype=np.int32)
+                // (shapes.writes // shapes.txns))
 
     def _clamp_off(self, version: int) -> int:
         off = version - self.base_version
@@ -609,24 +639,44 @@ class BatchEncoder:
         wt: list[int] = []
         snap = np.zeros(T, np.int32)
         valid = np.zeros(T, bool)
+        rpt, wpt = sh.reads // T, sh.writes // T
         for t, txn in enumerate(txns):
             if skip is not None and skip[t]:
                 continue  # host already decided TOO_OLD; not in this batch
             valid[t] = True
             snap[t] = self._clamp_off(txn.read_snapshot)
-            for b, e in txn.read_ranges:
+            # oversized txns were rejected by split_for_capacity (the gate on
+            # the detect path — raising there happens before any chunk of the
+            # logical batch touches device state)
+            for i, (b, e) in enumerate(txn.read_ranges):
                 rkeys_b.append(b)
                 rkeys_e.append(e)
-                rt.append(t)
-            for b, e in txn.write_ranges:
+                rt.append(t * rpt + i if sh.strided else t)
+            for i, (b, e) in enumerate(txn.write_ranges):
                 wkeys_b.append(b)
                 wkeys_e.append(e)
-                wt.append(t)
+                wt.append(t * wpt + i if sh.strided else t)
 
         rb = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
         re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
         wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
         we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
+        if sh.strided:
+            # ranges land at their txn's stride slots; rtxn/wtxn are implied
+            # by position and ignored by the kernel (cached device constants)
+            _bulk_encode_at(rkeys_b, rt, rb, round_up=False)
+            _bulk_encode_at(rkeys_e, rt, re, round_up=True)
+            _bulk_encode_at(wkeys_b, wt, wb, round_up=False)
+            _bulk_encode_at(wkeys_e, wt, we, round_up=True)
+            return {
+                "rb": jnp.asarray(rb), "re": jnp.asarray(re),
+                "rtxn": self._strided_rtxn,
+                "wb": jnp.asarray(wb), "we": jnp.asarray(we),
+                "wtxn": self._strided_wtxn,
+                "snapshot": jnp.asarray(snap), "txn_valid": jnp.asarray(valid),
+                "commit_version": jnp.int32(self._clamp_off(commit_version)),
+                "advance_floor": jnp.asarray(True),
+            }
         _bulk_encode(rkeys_b, rb, round_up=False)
         _bulk_encode(rkeys_e, re, round_up=True)
         _bulk_encode(wkeys_b, wb, round_up=False)
@@ -645,6 +695,19 @@ class BatchEncoder:
 
     def split_for_capacity(self, txns):
         sh = self.shapes
+        if sh.strided:
+            # capacity is per-txn (the stride); chunk by txn count only
+            rpt, wpt = sh.reads // sh.txns, sh.writes // sh.txns
+            for txn in txns:
+                if (len(txn.read_ranges) > rpt
+                        or len(txn.write_ranges) > wpt):
+                    raise FDBError(
+                        "transaction_too_large",
+                        f"{len(txn.read_ranges)} reads / "
+                        f"{len(txn.write_ranges)} writes exceed the strided "
+                        f"layout ({rpt}/{wpt} per txn)")
+            return [txns[i:i + sh.txns]
+                    for i in range(0, max(len(txns), 1), sh.txns)]
         subs, cur, nr, nw = [], [], 0, 0
         for txn in txns:
             tr, tw = len(txn.read_ranges), len(txn.write_ranges)
@@ -709,9 +772,10 @@ class DeviceConflictSet:
 
     def __init__(self, capacity: int | None = None, txns: int | None = None,
                  reads_per_txn: int | None = None, writes_per_txn: int | None = None,
-                 oldest_version: int = 0, key_bytes: int | None = None):
+                 oldest_version: int = 0, key_bytes: int | None = None,
+                 strided: bool = False):
         self.shapes = _resolve_shapes(capacity, txns, reads_per_txn,
-                                      writes_per_txn, key_bytes)
+                                      writes_per_txn, key_bytes, strided)
         self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)

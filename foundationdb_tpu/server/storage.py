@@ -226,6 +226,7 @@ class StorageServer:
         # gate, so once idle resolves no version can advance until the gate
         # lifts.
         self._ingest_idle = Future()
+        rc0 = self.recovery_count
         try:
             await self._ingest_idle
             c0 = self.version.get()
@@ -250,6 +251,14 @@ class StorageServer:
             # already queued below C0.
             muts = [Mutation(MutationType.CLEAR_RANGE, req.begin, end)]
             muts += [Mutation(MutationType.SET_VALUE, k, v) for k, v in rows]
+            if self.recovery_count != rc0:
+                # a recovery rollback landed mid-splice (the SetLogSystem
+                # handler is synchronous and bypasses the gate): the snapshot
+                # at c0 may include rolled-back versions, and applying it
+                # would put data/_pending_durable out of version order with
+                # the rewound pull cursor. Abort; the distributor retries.
+                raise FDBError("operation_failed",
+                               "recovery rollback during fetchKeys splice")
             # the parked loop is the only writer, so this must still hold:
             assert self.version.get() == c0, \
                 "ingestion advanced during a fetchKeys splice"

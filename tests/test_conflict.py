@@ -191,6 +191,42 @@ def test_randomized_parity(seed):
         check(dev, oracle, txns, version)
 
 
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_parity_strided(seed):
+    """The strided layout (static range->txn map; bench.py's configuration)
+    must make decisions identical to the oracle — including txns with zero
+    ranges, empty (b == e) read ranges (which still count for too-old), and
+    chunking across multiple sub-batches."""
+    KNOBS.set("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 500)
+    rng = DeterministicRandom(seed)
+    dev = small_device_set(txns=8, reads_per_txn=3, writes_per_txn=3,
+                           strided=True)
+    oracle = OracleConflictSet()
+    space = [bytes([97 + i]) + bytes([97 + j]) for i in range(6) for j in range(6)]
+    version = 0
+    for _batch in range(25):
+        version += rng.randint(1, 300)
+        txns = []
+        for _ in range(rng.randint(1, 20)):  # > txns shape -> chunking
+            snap = max(0, version - rng.randint(0, 800))
+            reads = [_random_range(rng, space) for _ in range(rng.randint(0, 3))]
+            writes = [_random_range(rng, space) for _ in range(rng.randint(0, 3))]
+            if rng.randint(0, 9) == 0 and reads:
+                reads[0] = (reads[0][0], reads[0][0])  # empty real range
+            txns.append(txn(snap, reads, writes))
+        check(dev, oracle, txns, version)
+
+
+def test_strided_rejects_oversized_txn():
+    from foundationdb_tpu.utils.errors import FDBError
+    dev = small_device_set(txns=4, reads_per_txn=2, writes_per_txn=2,
+                           strided=True)
+    big = txn(0, reads=[(bytes([97 + i]), bytes([98 + i])) for i in range(3)])
+    with pytest.raises(FDBError) as ei:
+        dev.detect([big], 100)
+    assert ei.value.name == "transaction_too_large"
+
+
 @pytest.mark.parametrize("seed", [11, 12])
 def test_randomized_parity_long_keys_and_prefixes(seed):
     rng = DeterministicRandom(seed)

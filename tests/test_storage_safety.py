@@ -1,0 +1,176 @@
+"""Regression tests for the storage server's safety mechanisms against a
+scripted TLog:
+
+1. Durability is clamped by the log system's known_committed_version: a
+   single TLog's peeks advance the pull cursor through never-fully-acked
+   versions, and those must never reach the durable engine (they can be
+   rolled back by a recovery, and rollback below durable is fatal).
+2. Rollback below the durable version kills the storage process (loud,
+   contained) instead of silently clamping and serving uncommitted data.
+3. A fetchKeys splice parks the update loop before snapshotting: a peek
+   reply already in flight when the gate is set is discarded, so the splice
+   cannot race ingestion past its snapshot version (the DD-liveness defect
+   where VersionedMap's version-order guard failed the move round after
+   round).
+
+Reference: storageserver.actor.cpp update/updateStorage/fetchKeys,
+TLogPeekReply knownCommittedVersion semantics.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.core.eventloop import EventLoop
+from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.core.sim import SimNetwork
+from foundationdb_tpu.server.interfaces import (
+    AddShardRequest, GetKeyValuesReply, LogEpoch, SetLogSystemRequest,
+    TLogPeekReply, Token)
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+from foundationdb_tpu.utils.types import Mutation, MutationType
+
+
+def _set(k, v):
+    return Mutation(MutationType.SET_VALUE, k, v)
+
+
+class ScriptedTLog:
+    """A fake TLog process: serves a fixed message list with a controllable
+    known_committed_version and an optional gate delaying one peek reply."""
+
+    def __init__(self, process, messages, end, kc):
+        self.process = process
+        self.messages = messages  # [(version, [Mutation])]
+        self.end = end
+        self.kc = kc
+        self.hold_next_peek: Future | None = None
+        process.register(Token.TLOG_PEEK, self._on_peek)
+        process.register(Token.TLOG_POP, lambda req, reply: reply.send(None))
+
+    def _on_peek(self, req, reply):
+        self.process.spawn(self._peek(req, reply), "scriptedPeek")
+
+    async def _peek(self, req, reply):
+        if self.hold_next_peek is not None:
+            gate, self.hold_next_peek = self.hold_next_peek, None
+            await gate
+        msgs = [(v, list(muts)) for v, muts in self.messages
+                if v >= req.begin]
+        reply.send(TLogPeekReply(messages=msgs, end=self.end, popped=0,
+                                 known_committed_version=self.kc))
+
+
+def _harness(seed=1):
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(seed))
+    return loop, net
+
+
+def test_durability_stalls_at_known_committed():
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"k%03d" % v, b"v")]) for v in range(1, 201)]
+    tlog = ScriptedTLog(tlog_proc, msgs, end=201, kc=100)
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"])
+
+    async def t():
+        await loop.delay(5.0)
+        # pull cursor reached the end, but durability stopped at kc
+        assert ss.version.get() == 200
+        assert ss.durable_version == 100, ss.durable_version
+        # acks catch up -> durability resumes to peek_begin - window
+        tlog.kc = 200
+        await loop.delay(5.0)
+        assert ss.durable_version == 190, ss.durable_version
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+def test_rollback_below_durable_kills_storage_process():
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"k%03d" % v, b"v")]) for v in range(1, 201)]
+    ScriptedTLog(tlog_proc, msgs, end=201, kc=200)
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"])
+    client = net.new_process("client:0")
+
+    async def t():
+        await loop.delay(5.0)
+        assert ss.durable_version == 190
+        # a recovery claims rollback below what this SS made durable
+        from foundationdb_tpu.core.sim import Endpoint
+        from foundationdb_tpu.utils.errors import FDBError
+        req = SetLogSystemRequest(
+            epochs=[LogEpoch(begin=0, end=None, addrs=["tlog:0"])],
+            rollback_to=150, recovery_count=ss.recovery_count + 1)
+        with pytest.raises(FDBError) as ei:
+            await net.request(client,
+                              Endpoint("ss:0", Token.STORAGE_SET_LOGSYSTEM),
+                              req)
+        # the reply races the kill: either the explicit internal_error or a
+        # broken promise from the dying process — never a silent success
+        assert ei.value.name in ("internal_error", "broken_promise",
+                                 "request_maybe_delivered")
+        assert not ss_proc.alive, "storage process must be dead"
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+def test_fetchkeys_discards_in_flight_peek():
+    """The splice must park ingestion BEFORE snapshotting: a peek held in
+    flight across the gate-set is discarded, not applied at versions above
+    the snapshot point."""
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"a%03d" % v, b"v")]) for v in range(1, 51)]
+    tlog = ScriptedTLog(tlog_proc, msgs, end=51, kc=50)
+
+    # source storage server for the snapshot
+    src_proc = net.new_process("src:0")
+    rows = [(b"m%02d" % i, b"s") for i in range(5)]
+
+    def on_get_kv(req, reply):
+        reply.send(GetKeyValuesReply(data=list(rows), more=False,
+                                     version=req.version))
+    src_proc.register(Token.STORAGE_GET_KEY_VALUES, on_get_kv)
+
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"],
+                       shard_ranges=[(b"a", b"b")])
+    client = net.new_process("client:0")
+
+    async def t():
+        from foundationdb_tpu.core.sim import Endpoint
+        await loop.delay(2.0)
+        assert ss.version.get() == 50
+        # hold the NEXT peek in flight, then extend the log so the held
+        # reply carries versions beyond the splice snapshot
+        gate = Future()
+        tlog.hold_next_peek = gate
+        await loop.delay(1.0)  # the update loop is now parked in the peek
+        tlog.messages.extend(
+            (v, [_set(b"a%03d" % v, b"v")]) for v in range(51, 61))
+        tlog.end = 61
+        fut = net.request(client, Endpoint("ss:0", Token.STORAGE_ADD_SHARD),
+                          AddShardRequest(begin=b"m", end=b"n",
+                                          source="src:0", fence_version=40))
+        await loop.delay(1.0)
+        gate._set(None)  # release the held peek WHILE the splice waits
+        c0 = await fut  # splice must complete (no internal_error)
+        assert c0 == 50, c0
+        await loop.delay(2.0)
+        # ingestion resumed past the splice point and nothing was lost
+        assert ss.version.get() == 60
+        for k, v in rows:
+            assert ss.data.get(k, 60) == b"s", k
+        assert ss.data.get(b"a060", 60) == b"v"
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
