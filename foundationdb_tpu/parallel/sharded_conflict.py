@@ -97,12 +97,29 @@ def _clip_ranges(b, e, lo, hi):
     return b2, e2
 
 
+_STEP_CACHE: dict = {}
+
+
 def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
                           max_write_life: int):
+    key = (tuple(mesh.devices.flat), shapes, max_write_life)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    fn = _build_sharded_step(mesh, shapes, max_write_life)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
+                        max_write_life: int):
     """Build the jitted SPMD step: (stacked_state, batch) -> (state', statuses, info).
 
     stacked_state: state pytree with a leading n_shards axis, sharded over the
-    mesh; batch: replicated (same encoding as conflict_step's batch).
+    mesh; batch: replicated (same encoding as conflict_step's batch). The
+    shard's owned key range [lo, hi) is PART OF THE STATE (not baked into the
+    program), so resolutionBalancing can re-cut the partition between batches
+    without recompiling.
     """
     if shapes.key_bytes != keylib.KEY_BYTES:
         raise ValueError(
@@ -110,19 +127,18 @@ def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
             f"({keylib.KEY_BYTES}B); got key_bytes={shapes.key_bytes}. "
             "Thread shapes.limbs through shard_cut_keys/_clip_ranges to "
             "narrow it.")
-    n = mesh.devices.size
-    cuts = jnp.asarray(shard_cut_keys(n))  # (n+1, L) — baked constant
 
     def local_step(state, batch):
-        d = lax.axis_index(RESOLVER_AXIS)
-        lo = cuts[d].astype(jnp.uint32)
-        hi = cuts[d + 1].astype(jnp.uint32)
         state = jax.tree.map(lambda x: x[0], state)  # drop leading shard dim
+        lo = state.pop("lo")
+        hi = state.pop("hi")
         batch = dict(batch)
         batch["rb"], batch["re"] = _clip_ranges(batch["rb"], batch["re"], lo, hi)
         batch["wb"], batch["we"] = _clip_ranges(batch["wb"], batch["we"], lo, hi)
         new_state, statuses, info = conflict_step(
             state, batch, shapes=shapes, max_write_life=max_write_life)
+        new_state["lo"] = lo
+        new_state["hi"] = hi
         # proxy combine: min over shards (MasterProxyServer.actor.cpp:492-504)
         statuses = lax.pmin(statuses, RESOLVER_AXIS)
         info = {
@@ -137,6 +153,7 @@ def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
         "bkeys": P(RESOLVER_AXIS), "bval": P(RESOLVER_AXIS),
         "nb": P(RESOLVER_AXIS), "oldest": P(RESOLVER_AXIS),
         "table": P(RESOLVER_AXIS), "poisoned": P(RESOLVER_AXIS),
+        "lo": P(RESOLVER_AXIS), "hi": P(RESOLVER_AXIS),
     }
     batch_specs = {
         "rb": P(), "re": P(), "rtxn": P(), "wb": P(), "we": P(), "wtxn": P(),
@@ -156,11 +173,20 @@ def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
     return jax.jit(sharded)
 
 
-def init_sharded_state(shapes: ConflictShapes, n_shards: int, oldest: int = 0):
-    """Stacked per-shard initial states, leading axis = shard."""
+def init_sharded_state(shapes: ConflictShapes, n_shards: int, oldest: int = 0,
+                       cut_bytes: list[bytes] | None = None):
+    """Stacked per-shard initial states, leading axis = shard. Each shard
+    carries its owned range [lo, hi) as state (dynamic cuts)."""
     one = init_state(shapes, oldest=oldest)
-    return jax.tree.map(
+    st = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n_shards,) + x.shape), one)
+    cuts = np.zeros((n_shards + 1, L), dtype=np.uint32)
+    for d, kb in enumerate(cut_bytes or shard_cut_bytes(n_shards)):
+        cuts[d] = keylib.encode_key(kb)
+    cuts[n_shards, :] = 0xFFFFFFFF
+    st["lo"] = jnp.asarray(cuts[:n_shards])
+    st["hi"] = jnp.asarray(cuts[1:])
+    return st
 
 
 class ShardedDeviceConflictSet:
@@ -173,7 +199,8 @@ class ShardedDeviceConflictSet:
 
     def __init__(self, mesh: Mesh | None = None, capacity: int | None = None,
                  txns: int | None = None, reads_per_txn: int | None = None,
-                 writes_per_txn: int | None = None, oldest_version: int = 0):
+                 writes_per_txn: int | None = None, oldest_version: int = 0,
+                 cut_bytes: list[bytes] | None = None):
         from foundationdb_tpu.ops.conflict import BatchEncoder, _resolve_shapes
 
         self.mesh = mesh or make_resolver_mesh()
@@ -181,9 +208,20 @@ class ShardedDeviceConflictSet:
         self.shapes = _resolve_shapes(capacity, txns, reads_per_txn, writes_per_txn)
         self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
         self.oldest_version = oldest_version
-        self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0)
+        self.cut_bytes = list(cut_bytes or shard_cut_bytes(self.n_shards))
+        assert self.cut_bytes[0] == b"" and len(self.cut_bytes) == self.n_shards
+        self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0,
+                                         cut_bytes=self.cut_bytes)
         self._step = sharded_conflict_step(
             self.mesh, self.shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        # resolutionBalancing inputs (masterserver.actor.cpp:955-1012 via
+        # Resolver iops sampling :146-151): per-shard range counts + a
+        # bounded reservoir of range-begin prefixes
+        self._load_counts = np.zeros(self.n_shards, dtype=np.int64)
+        self._samples: list[int] = []  # first-4-byte ints of range begins
+        self._batches_since_check = 0
+        self._sample_rng = np.random.RandomState(0)
+        self.rebalances = 0
 
     @property
     def base_version(self) -> int:
@@ -193,7 +231,12 @@ class ShardedDeviceConflictSet:
         while commit_version - self.encoder.base_version > _REBASE_THRESHOLD:
             delta = min(commit_version - self.encoder.base_version - (1 << 24),
                         1 << 30)
-            self._state = jax.vmap(lambda s: rebase_state(s, delta))(self._state)
+            lo, hi = self._state["lo"], self._state["hi"]
+            core = {k: v for k, v in self._state.items()
+                    if k not in ("lo", "hi")}
+            core = jax.vmap(lambda s: rebase_state(s, delta))(core)
+            core["lo"], core["hi"] = lo, hi
+            self._state = core
             self.encoder.base_version += delta
 
     def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
@@ -202,9 +245,168 @@ class ShardedDeviceConflictSet:
     def detect_async(self, txns: list[TxnConflictInfo], commit_version: int):
         from foundationdb_tpu.ops.conflict import detect_async_impl
 
+        self._record_load(txns)
+        self._batches_since_check += 1
+        if self._batches_since_check >= KNOBS.RESOLUTION_BALANCE_CHECK_BATCHES:
+            self._batches_since_check = 0
+            self.maybe_rebalance(commit_version)
         return detect_async_impl(self, txns, commit_version)
 
     def clear(self, oldest_version: int = 0):
         self.encoder.base_version = oldest_version
         self.oldest_version = oldest_version
-        self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0)
+        self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0,
+                                         cut_bytes=self.cut_bytes)
+        # stale load/samples must not drive a rebalance of the fresh state
+        self._load_counts[:] = 0
+        self._samples.clear()
+        self._batches_since_check = 0
+
+    # -- resolutionBalancing --
+
+    def _record_load(self, txns):
+        """One vectorized pass per batch (this rides the resolver hot path:
+        per-range Python would cost as much as the device step itself)."""
+        begins = [b for t in txns for b, _e in t.read_ranges]
+        wbegins = [b for t in txns for b, _e in t.write_ranges]
+        if not begins and not wbegins:
+            return
+        prefixes = np.array(
+            [int.from_bytes(b[:4].ljust(4, b"\x00"), "big")
+             for b in begins + wbegins], dtype=np.uint64)
+        cut_pref = np.array(
+            [int.from_bytes(cb[:4].ljust(4, b"\x00"), "big")
+             for cb in self.cut_bytes], dtype=np.uint64)
+        shard_idx = np.searchsorted(cut_pref, prefixes, side="right") - 1
+        np.add.at(self._load_counts, shard_idx, 1)
+        wpref = prefixes[len(begins):]
+        cap = 8192
+        room = cap - len(self._samples)
+        if room > 0:
+            self._samples.extend(wpref[:room].tolist())
+            wpref = wpref[room:]
+        if len(wpref):
+            js = self._sample_rng.randint(0, cap, size=len(wpref))
+            for j, v in zip(js.tolist(), wpref.tolist()):
+                self._samples[j] = v
+
+    def maybe_rebalance(self, at_version: int) -> bool:
+        """Re-cut the key partition when per-shard load skews (the between-
+        batches analogue of masterserver resolutionBalancing: sampled load ->
+        new cuts -> state restructure). Returns True if a rebalance ran."""
+        total = int(self._load_counts.sum())
+        if (total < KNOBS.RESOLUTION_BALANCE_MIN_SAMPLES
+                or len(self._samples) < self.n_shards * 4):
+            return False
+        mean = total / self.n_shards
+        if self._load_counts.max() <= KNOBS.RESOLUTION_BALANCE_SKEW * mean:
+            return False
+        qs = np.quantile(np.asarray(self._samples, dtype=np.float64),
+                         [d / self.n_shards for d in range(1, self.n_shards)])
+        new_cuts = [b""]
+        for q in qs:
+            cb = int(min(max(q, 0), (1 << 32) - 1)).to_bytes(4, "big")
+            if cb <= new_cuts[-1]:
+                return False  # degenerate sample (mass on one prefix): keep cuts
+            new_cuts.append(cb)
+        self.rebalance_cuts(new_cuts, at_version)
+        return True
+
+    def rebalance_cuts(self, new_cut_bytes: list[bytes], at_version: int):
+        """Move the partition to `new_cut_bytes`. Conflict state is SOFT
+        (clearConflictSet semantics, SkipList.cpp:957): a shard's newly
+        acquired subranges are filled at `at_version` — conservative-only
+        (stale reads there conflict; never a false commit) — while retained
+        subranges keep exact history. No cross-shard state movement, no
+        recompilation (cuts are state, not program constants)."""
+        from jax.sharding import NamedSharding
+
+        assert len(new_cut_bytes) == self.n_shards and new_cut_bytes[0] == b""
+        K = self.shapes.capacity
+        st = jax.device_get(self._state)
+        vfill = np.int32(self.encoder._clamp_off(at_version))
+
+        cuts = np.zeros((self.n_shards + 1, L), dtype=np.uint32)
+        for d, kb in enumerate(new_cut_bytes):
+            cuts[d] = keylib.encode_key(kb)
+        cuts[self.n_shards, :] = 0xFFFFFFFF
+
+        old_lo, old_hi = st["lo"], st["hi"]  # (n, L)
+        nb = st["nb"]
+        new_bkeys = np.full_like(st["bkeys"], 0xFFFFFFFF)
+        new_bval = np.full_like(st["bval"], int(NEG))
+        new_nb = np.zeros_like(nb)
+
+        def np_lt1(a, b):  # lexicographic a < b over (L,) uint32
+            for i in range(L):
+                if a[i] != b[i]:
+                    return a[i] < b[i]
+            return False
+
+        def np_cmp_vec(keys, q):  # (L, N) keys vs (L,) q -> (lt, eq) masks
+            lt = np.zeros(keys.shape[1], bool)
+            eq = np.ones(keys.shape[1], bool)
+            for i in range(L):
+                lt |= eq & (keys[i] < q[i])
+                eq &= keys[i] == q[i]
+            return lt, eq
+
+        for d in range(self.n_shards):
+            lo, hi = cuts[d], cuts[d + 1]
+            a = old_lo[d] if np_lt1(lo, old_lo[d]) else lo  # retained begin
+            b = old_hi[d] if np_lt1(hi, old_hi[d]) else hi  # retained end
+            keys_d = st["bkeys"][d]  # (L, K)
+            vals_d = st["bval"][d]
+            live = np.arange(K) < int(nb[d])
+            out_k: list[np.ndarray] = []  # (L, ni) pieces
+            out_v: list[np.ndarray] = []
+            if np_lt1(a, b):  # retained interval non-empty
+                if np_lt1(lo, a):  # acquired prefix [lo, a)
+                    out_k.append(lo[:, None])
+                    out_v.append(np.asarray([vfill], np.int32))
+                # value in effect at `a` = last live boundary <= a
+                lt_a, eq_a = np_cmp_vec(keys_d, a)
+                le_a = live & (lt_a | eq_a)
+                n_le = int(le_a.sum())
+                at_a = int(vals_d[n_le - 1]) if n_le else int(NEG)
+                out_k.append(a[:, None])
+                out_v.append(np.asarray([at_a], np.int32))
+                lt_b, _ = np_cmp_vec(keys_d, b)
+                interior = live & ~(lt_a | eq_a) & lt_b
+                out_k.append(keys_d[:, interior])
+                out_v.append(vals_d[interior])
+                if np_lt1(b, hi):  # acquired suffix [b, hi)
+                    out_k.append(b[:, None])
+                    out_v.append(np.asarray([vfill], np.int32))
+            else:
+                # nothing retained: whole new range conservative
+                out_k.append(lo[:, None])
+                out_v.append(np.asarray([vfill], np.int32))
+            kcat = np.concatenate(out_k, axis=1)
+            vcat = np.concatenate(out_v)
+            if kcat.shape[1] > K:
+                # cannot represent: collapse to fully conservative (safe)
+                kcat = lo[:, None]
+                vcat = np.asarray([vfill], np.int32)
+            n = kcat.shape[1]
+            new_bkeys[d, :, :n] = kcat
+            new_bval[d, :n] = vcat
+            new_nb[d] = n
+
+        from foundationdb_tpu.ops.conflict import _build_table
+        sharding = NamedSharding(self.mesh, P(RESOLVER_AXIS))
+        bval_dev = jax.device_put(new_bval, sharding)
+        self._state = {
+            "bkeys": jax.device_put(new_bkeys, sharding),
+            "bval": bval_dev,
+            "nb": jax.device_put(new_nb, sharding),
+            "oldest": self._state["oldest"],
+            "table": jax.jit(jax.vmap(_build_table))(bval_dev),
+            "poisoned": self._state["poisoned"],
+            "lo": jax.device_put(cuts[: self.n_shards], sharding),
+            "hi": jax.device_put(cuts[1:], sharding),
+        }
+        self.cut_bytes = list(new_cut_bytes)
+        self._load_counts[:] = 0
+        self._samples.clear()
+        self.rebalances += 1

@@ -23,9 +23,20 @@ from foundationdb_tpu.utils.knobs import KNOBS
 
 
 def new_conflict_set(oldest_version: int = 0):
-    """newConflictSet() dispatch (ConflictSet.h:28) on the CONFLICT_BACKEND knob."""
+    """newConflictSet() dispatch (ConflictSet.h:28) on the CONFLICT_BACKEND knob.
+
+    "device"  — single-device JAX kernel
+    "sharded" — key-partitioned SPMD engine over the full device mesh
+                (parallel/sharded_conflict.py), with resolutionBalancing
+                (load-sampled cut moves) built in
+    "oracle"  — pure-Python CPU reference
+    """
     if KNOBS.CONFLICT_BACKEND == "device":
         return DeviceConflictSet(oldest_version=oldest_version)
+    if KNOBS.CONFLICT_BACKEND == "sharded":
+        from foundationdb_tpu.parallel.sharded_conflict import (
+            ShardedDeviceConflictSet)
+        return ShardedDeviceConflictSet(oldest_version=oldest_version)
     return OracleConflictSet(oldest_version=oldest_version)
 
 

@@ -76,7 +76,14 @@ KNOBS.init("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 100_000)
 KNOBS.init("COMMIT_BATCH_IDLE_INTERVAL", 0.25)  # empty-batch keepalive
 
 # --- Conflict engine (device) ---
-KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "oracle" (CPU reference)
+KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "sharded" (mesh) | "oracle" (CPU reference)
+# resolutionBalancing analogue (masterserver.actor.cpp:955-1012): the sharded
+# engine re-cuts its key partition from sampled range begins when per-shard
+# load skews. Checked every N batches; rebalances when the hottest shard
+# carries > SKEW x the mean; needs MIN_SAMPLES sampled begins first.
+KNOBS.init("RESOLUTION_BALANCE_CHECK_BATCHES", 64, (4,))
+KNOBS.init("RESOLUTION_BALANCE_SKEW", 2.0)
+KNOBS.init("RESOLUTION_BALANCE_MIN_SAMPLES", 2048, (32,))
 KNOBS.init("CONFLICT_STATE_CAPACITY", 1 << 16, (1 << 10,))  # boundary slots
 KNOBS.init("CONFLICT_BATCH_TXNS", 1024)  # static batch shape: txns
 KNOBS.init("CONFLICT_BATCH_READS_PER_TXN", 4)
