@@ -71,11 +71,16 @@ class Transaction:
         sel = selector
         if sel.offset >= 1:
             begin = sel.key + (b"\x00" if sel.or_equal else b"")
-            data = await self.get_range(begin, b"\xff", limit=sel.offset,
+            # user selectors stop at \xff; selectors whose base is already in
+            # the system keyspace may walk to its end \xff\xff (the
+            # reference clamps getKey to the legal range — system rows are
+            # stored like normal data and must not leak into user scans)
+            scan_end = b"\xff\xff" if sel.key >= b"\xff" else b"\xff"
+            data = await self.get_range(begin, scan_end, limit=sel.offset,
                                         snapshot=snapshot)
             if len(data) >= sel.offset:
                 return data[sel.offset - 1][0]
-            return b"\xff"
+            return scan_end
         nth = 1 - sel.offset
         end = sel.key + (b"\x00" if sel.or_equal else b"")
         data = await self.get_range(b"", end, limit=nth, reverse=True,

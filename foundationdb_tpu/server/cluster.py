@@ -50,7 +50,8 @@ class SimCluster:
 
         # -- role state --
         self.master = Master(self.master_proc)
-        self.resolvers = [Resolver(p) for p in self.resolver_procs]
+        self.resolvers = [Resolver(p, n_proxies=n_proxies)
+                          for p in self.resolver_procs]
         self.tlogs = [TLog(p) for p in self.tlog_procs]
 
         # storage sharding: shard i served by storage i (tag = i); every tlog

@@ -32,8 +32,9 @@ from foundationdb_tpu.server.coordination import (
 from foundationdb_tpu.server.interfaces import (
     AddShardRequest, DBInfo, GetStorageMetricsRequest, InitRoleRequest,
     LogEpoch, RegisterWorkerRequest, SetLogSystemRequest, SetShardsRequest,
-    TLogLockRequest, Token, UpdateShardsRequest)
+    TLogLockRequest, Token)
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.types import Mutation, MutationType
 from foundationdb_tpu.utils.keys import partition_boundaries as _partition_boundaries
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.trace import TraceEvent
@@ -307,7 +308,8 @@ class ClusterController:
 
         resolver_addrs = await self._recruit_many(
             stateless, cfg.n_resolvers, "resolver",
-            lambda i: {"recovery_version": start_version})
+            lambda i: {"recovery_version": start_version,
+                       "n_proxies": cfg.n_proxies})
         master_addr = (await self._recruit_many(
             stateless, 1, "master",
             lambda i: {"recovery_version": start_version, "epoch": epoch,
@@ -353,8 +355,13 @@ class ClusterController:
             lambda i: {"tlogs": list(tlog_addrs),
                        "storages": [a for a, _t in storages]}))[0]
 
-        from foundationdb_tpu.server.proxy import ResolverMap, ShardMap
-        shard_map = ShardMap(boundaries=boundaries, tags=shard_tags)
+        from foundationdb_tpu.server import systemdata
+        from foundationdb_tpu.server.proxy import ResolverMap
+        # seed every proxy's txnStateStore with the \xff snapshot derived
+        # from the coordinated checkpoint (the recovery transaction /
+        # sendInitialCommitToResolvers analogue, masterserver.actor.cpp:690)
+        system_snapshot = systemdata.build_keyservers_snapshot(
+            boundaries, shard_tags)
         resolver_map = ResolverMap(
             boundaries=_partition_boundaries(cfg.n_resolvers),
             endpoints=[Endpoint(a, Token.RESOLVER_RESOLVE)
@@ -372,7 +379,7 @@ class ClusterController:
                     "resolvers": resolver_map,
                     "tlogs": [Endpoint(a, Token.TLOG_COMMIT) for a in tlog_addrs],
                     "tlog_uids": list(uids),
-                    "shards": shard_map,
+                    "system_snapshot": list(system_snapshot),
                     "recovery_version": start_version,
                     "epoch": epoch,
                     "other_proxies": [a for a in proxy_addrs
@@ -428,6 +435,15 @@ class ClusterController:
         TraceEvent("CCRecovered", self.process.address) \
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()
+
+        # recovery transaction: write the \xff snapshot INTO the database
+        # (the reference's recovery txn + sendInitialCommitToResolvers,
+        # masterserver.actor.cpp:597-690) — the proxies' caches were seeded
+        # directly, but DD's read-modify-write layout txns need the rows
+        # readable/conflict-checkable through the normal pipeline
+        self._initial_meta_done = False
+        self._watchers.append(self.process.spawn(
+            self._write_initial_metadata(system_snapshot), "recoveryTxn"))
 
         # shard tracker / relocator (DataDistribution.actor.cpp:2260 runs
         # alongside the master; here it runs with the CC and survives until
@@ -530,7 +546,8 @@ class ClusterController:
         while True:
             await self.loop.delay(KNOBS.DD_INTERVAL_SECONDS)
             info = self.dbinfo
-            if self.deposed or info.recovery_state != "accepting_commits":
+            if self.deposed or info.recovery_state != "accepting_commits" \
+                    or not getattr(self, "_initial_meta_done", False):
                 continue
             try:
                 await self._dd_once()
@@ -568,11 +585,84 @@ class ClusterController:
                 await self._merge(i)
                 return
 
+    async def _write_initial_metadata(self, snapshot):
+        """Persist the recovery's \\xff snapshot through the pipeline
+        (idempotent: re-writes the cstate-derived layout; a ghost from a
+        deposed generation dies at its locked TLogs). DD mutations wait on
+        this."""
+        from foundationdb_tpu.server import systemdata
+        db = self._dd_database()
+        while not self.deposed:
+            try:
+                await db.refresh(max_wait=5.0)
+                tr = db.create_transaction()
+                tr.clear_range(systemdata.KEY_SERVERS_PREFIX,
+                               systemdata.KEY_SERVERS_END)
+                for k, v in snapshot:
+                    tr.set(k, v)
+                await tr.commit()  # RPCs inside are individually bounded
+                self._initial_meta_done = True
+                return
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                await self.loop.delay(1.0)
+
+    def _dd_database(self):
+        """Client handle the DD uses to run layout transactions (the
+        reference's DD runs its moveKeys transactions through NativeAPI,
+        DataDistribution.actor.cpp; MoveKeys.actor.cpp)."""
+        if getattr(self, "_dd_db", None) is None:
+            from foundationdb_tpu.client.database import Database
+            self._dd_db = Database(self.process,
+                                   coordinators=list(self.coordinators))
+        return self._dd_db
+
+    async def _commit_metadata_txn(self, info, expected: dict, mutations) -> int:
+        """Run a layout metadata transaction through the commit pipeline (the
+        moveKeys-transaction analogue, MoveKeys.actor.cpp): resolved by every
+        resolver, applied to every proxy's txnStateStore in version order.
+
+        `expected` maps each touched \\xff key to the value this round
+        believes is current; the transaction READS those keys (conflict
+        ranges at its snapshot) and aborts if they moved. This makes a ghost
+        commit — an RPC the CC timed out on that delivers later — harmless:
+        either the keyspace is unchanged (the ghost re-writes the same
+        values) or something advanced it and the ghost CONFLICTS. A timeout
+        here fails the DD round; the next round re-reads the live layout.
+
+        Returns the commit version — by the pipeline's ordering guarantee,
+        every batch with a later version routes with the new map, so the
+        returned version IS the routing fence."""
+        db = self._dd_database()
+        await db.refresh(max_wait=5.0)
+        tr = db.create_transaction()
+        try:
+            for k, want in expected.items():
+                cur = await tr.get(k)
+                if cur != want:
+                    raise FDBError("operation_failed",
+                                   f"layout moved under DD: {k!r}")
+            for m in mutations:
+                if m.type == MutationType.CLEAR_RANGE:
+                    tr.clear_range(m.param1, m.param2)
+                else:
+                    tr.set(m.param1, m.param2)
+            await tr.commit()
+            return tr.committed_version
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            raise FDBError("operation_failed",
+                           f"metadata txn failed: {e.name}") from None
+
     async def _merge(self, i: int):
-        """Drop the boundary between shards i and i+1 (same team): update
-        proxies, publish through the cstate, then DBInfo. Stale layouts stay
-        correct — the union of the halves is exactly the merged shard on the
-        same servers."""
+        """Drop the boundary between shards i and i+1 (same team): one
+        metadata transaction clears its \\xff/keyServers entry (every proxy
+        applies it in version order), then publish through the cstate and
+        DBInfo. Stale layouts stay correct — the union of the halves is
+        exactly the merged shard on the same servers."""
+        from foundationdb_tpu.server import systemdata
         info = self.dbinfo
         b = list(info.shard_boundaries)
         teams = [list(t) for t in info.teams()]
@@ -580,10 +670,12 @@ class ClusterController:
         new_teams = teams[:i + 1] + teams[i + 2:]
         TraceEvent("DDMergeShards", self.process.address) \
             .detail("At", b[i + 1].hex()).log()
-        for pa in info.proxies:
-            await self.loop.timeout(self.net.request(
-                self.process, Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
-                UpdateShardsRequest(boundaries=new_b, tags=new_teams)), 2.0)
+        k = systemdata.keyservers_key(b[i + 1])
+        await self._commit_metadata_txn(
+            info,
+            {k: systemdata.encode_tags(teams[i + 1]),
+             systemdata.keyservers_key(b[i]): systemdata.encode_tags(teams[i])},
+            [Mutation(MutationType.CLEAR_RANGE, k, k + b"\x00")])
         await self._publish_layout(new_b, new_teams)
         # the merged team's storage servers must coalesce their served
         # ranges too: _owns_range requires a request to fit ONE entry, so a
@@ -645,27 +737,27 @@ class ClusterController:
         # writes until the layout is published, and a CC crash mid-move
         # leaves the old cstate layout fully correct (the source missed
         # nothing; the destination's partial copy is simply never served)
+        from foundationdb_tpu.server import systemdata
         both = sorted(set(old_team) | set(dest))
-        interim_teams = teams[:i + 1] + [both] + teams[i + 1:]
         TraceEvent("DDSplitShard", self.process.address) \
             .detail("At", split_key.hex()).detail("Move", dest != old_team).log()
 
-        # 1. dual-route: every proxy swaps its map (awaited: the fence below
-        # is only meaningful once no proxy still routes with the old map)
-        for pa in info.proxies:
-            await self.loop.timeout(self.net.request(
-                self.process, Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
-                UpdateShardsRequest(boundaries=new_b, tags=interim_teams)),
-                2.0)
-        # 2. read-only version fence: every batch still carrying the old
-        # routing was allocated its version BEFORE this read (allocation
-        # precedes routing within a batch), so all its mutations are <= fence
-        # and the snapshot fetched at >= fence includes them
-        fence = await self.loop.timeout(self.net.request(
-            self.process,
-            Endpoint(info.master, Token.MASTER_GET_CURRENT_VERSION), None),
-            2.0)
-        # 3. destination fetches (no-op when the team keeps the shard)
+        # 1. dual-route via a metadata transaction: \xff/keyServers/<split>
+        # = union team flows through the pipeline; every proxy applies it in
+        # version order BEFORE routing any later batch, so the txn's commit
+        # version IS the fence — every mutation with a later version is
+        # routed to both teams (the moveKeys startMoveKeys analogue). The
+        # expected-value reads abort the txn (or any delayed ghost of an
+        # earlier round) if the layout moved.
+        fence = await self._commit_metadata_txn(
+            info,
+            {systemdata.keyservers_key(b[i]): systemdata.encode_tags(old_team),
+             systemdata.keyservers_key(split_key): None},
+            [Mutation(MutationType.SET_VALUE,
+                      systemdata.keyservers_key(split_key),
+                      systemdata.encode_tags(both))])
+        # 2. destination fetches at/above the fence (no-op when the team
+        # keeps the shard)
         if dest != old_team:
             src = addr_of_tag[old_team[0]]
             for tag in dest:
@@ -674,16 +766,19 @@ class ClusterController:
                     Endpoint(addr_of_tag[tag], Token.STORAGE_ADD_SHARD),
                     AddShardRequest(begin=split_key, end=hi, source=src,
                                     fence_version=fence)), 30.0)
-        # 4. publish: cstate first (a concurrent recovery must see the new
+        # 3. publish: cstate first (a concurrent recovery must see the new
         # layout), then DBInfo for clients; finally shrink the source
         await self._publish_layout(new_b, new_teams)
-        # 5. end the dual-route window: final single-team routing, then the
-        # source stops serving the moved range (stale clients get
-        # wrong_shard_server and re-resolve through the published layout)
-        for pa in info.proxies:
-            self.net.one_way(self.process,
-                             Endpoint(pa, Token.PROXY_UPDATE_SHARDS),
-                             UpdateShardsRequest(boundaries=new_b,
-                                                 tags=new_teams))
+        # 4. end the dual-route window (finishMoveKeys analogue): final
+        # single-team entry, then the source stops serving the moved range
+        # (stale clients get wrong_shard_server and re-resolve through the
+        # published layout)
+        await self._commit_metadata_txn(
+            info,
+            {systemdata.keyservers_key(split_key):
+                 systemdata.encode_tags(both)},
+            [Mutation(MutationType.SET_VALUE,
+                      systemdata.keyservers_key(split_key),
+                      systemdata.encode_tags(dest))])
         if dest != old_team:
             self._push_team_ranges(old_team, new_b, new_teams, addr_of_tag)

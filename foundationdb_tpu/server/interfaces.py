@@ -106,17 +106,33 @@ class GetReadVersionReply:
 @dataclass
 class ResolveTransactionBatchRequest:
     """ResolverInterface.h:83-91. (prev_version -> version) chains batches
-    into a total order per resolver across all proxies."""
+    into a total order per resolver across all proxies.
+
+    State (metadata) transactions — those with mutations on the \\xff
+    system keyspace — are registered with EVERY resolver via
+    `state_txn_indices` (indices into `transactions`); their mutations ride
+    only in resolver 0's request (`state_txn_mutations`, parallel to the
+    indices; empty lists elsewhere), mirroring
+    MasterProxyServer.actor.cpp:307-311 / ResolutionRequestBuilder."""
 
     prev_version: int
     version: int
-    last_receive_version: int
+    last_receive_version: int  # this proxy's own previous batch version
     transactions: list  # list[TxnConflictInfo]
+    proxy_id: int = 0
+    state_txn_indices: list = None  # list[int] | None
+    state_txn_mutations: list = None  # list[list[Mutation]] | None
 
 
 @dataclass
 class ResolveTransactionBatchReply:
     committed: list[int]  # per-txn {CONFLICT, TOO_OLD, COMMITTED}
+    # state txns from versions in (last_receive_version, version) — other
+    # proxies' batches this proxy hasn't seen (Resolver.actor.cpp:170-190):
+    # [(version, [(locally_committed, mutations), ...]), ...] version-sorted.
+    # A proxy ANDs `locally_committed` across ALL resolvers' replies for the
+    # global verdict (MasterProxyServer.actor.cpp:452-489).
+    state_mutations: list = None
 
 
 # --- tlog ---
@@ -325,7 +341,9 @@ class SetShardsRequest:
 
 @dataclass
 class UpdateShardsRequest:
-    """Proxy shard-map swap (the applyMetadataMutations keyServers update)."""
+    """RETIRED: shard-map changes now flow as \\xff/keyServers metadata
+    transactions through the commit pipeline (systemdata.py). Kept only to
+    pin wire id 32 (the registry is append-only)."""
 
     boundaries: list
     tags: list  # list[list[int]]

@@ -99,11 +99,13 @@ class ResolverMap:
 class Proxy:
     def __init__(self, process: SimProcess, proxy_id: int, master: Endpoint,
                  resolvers: ResolverMap, tlogs: list[Endpoint],
-                 shards: ShardMap, recovery_version: int = 0,
+                 shards: ShardMap | None = None, recovery_version: int = 0,
                  other_proxies: list[str] | None = None, epoch: int = 0,
                  ratekeeper: str | None = None, n_proxies: int = 1,
                  tlog_uids: list[str] | None = None,
-                 die_on_failure: bool = False):
+                 die_on_failure: bool = False,
+                 system_snapshot: list | None = None):
+        from foundationdb_tpu.server import systemdata
         self.process = process
         self.loop = process.net.loop
         self.proxy_id = proxy_id
@@ -112,7 +114,19 @@ class Proxy:
         self.resolvers = resolvers
         self.tlogs = tlogs
         self.tlog_uids = tlog_uids or [""] * len(tlogs)
-        self.shards = shards
+        # txnStateStore: the system keyspace subset this proxy caches,
+        # seeded from the recovery snapshot (or synthesized from a directly
+        # supplied ShardMap in statically-built clusters) and maintained by
+        # metadata mutations flowing through the commit pipeline
+        # (ApplyMetadataMutation.h; MasterProxyServer.actor.cpp:452-489)
+        if system_snapshot is None:
+            assert shards is not None, "need shards or system_snapshot"
+            system_snapshot = systemdata.build_keyservers_snapshot(
+                shards.boundaries, shards.tags)
+        self.txn_state = systemdata.TxnStateStore(system_snapshot)
+        self.txn_state_version = recovery_version
+        self.shards = self._shards_from_txn_state()
+        self._last_batch_version = recovery_version  # own previous batch
         self.other_proxies = [Endpoint(a, Token.PROXY_GET_COMMITTED_VERSION)
                               for a in (other_proxies or [])]
         self._request_num = 0
@@ -135,7 +149,6 @@ class Proxy:
         process.register(Token.PROXY_GET_COMMITTED_VERSION,
                          self._on_get_committed_version)
         process.register(Token.PROXY_PING, self._on_proxy_ping)
-        process.register(Token.PROXY_UPDATE_SHARDS, self._on_update_shards)
         self._lease_task = process.spawn(self._master_lease_loop(), "masterLease")
         self._last_flush = self.loop.now()
         # idle empty batches (the reference's MAX_COMMIT_BATCH_INTERVAL
@@ -182,15 +195,29 @@ class Proxy:
     def _on_proxy_ping(self, req, reply):
         reply.send(self.epoch)
 
-    def _on_update_shards(self, req, reply):
-        """Shard-map swap from the data distributor (the reference's
-        applyMetadataMutations keyInfo update). Mutation routing reads
-        self.shards at phase 3, so every batch not yet routed — including
-        in-flight ones — uses the new map from this instant on; the
-        distributor takes its version fence AFTER this ack."""
-        self.shards = ShardMap(boundaries=list(req.boundaries),
-                               tags=[list(t) for t in req.tags])
-        reply.send(None)
+    def _shards_from_txn_state(self) -> ShardMap:
+        """Derive the routing map (keyInfo) from \\xff/keyServers in the
+        txnStateStore (ApplyMetadataMutation.h keyInfo maintenance)."""
+        from foundationdb_tpu.server import systemdata
+        items = self.txn_state.get_range(systemdata.KEY_SERVERS_PREFIX,
+                                         systemdata.KEY_SERVERS_END)
+        boundaries, teams = systemdata.parse_keyservers(items)
+        assert boundaries and boundaries[0] == b"", \
+            "keyServers must cover the keyspace from b''"
+        return ShardMap(boundaries=boundaries, tags=teams)
+
+    def _apply_metadata(self, mutations, version: int):
+        """Fold committed metadata mutations into the txnStateStore and
+        refresh the routing map if keyServers changed."""
+        from foundationdb_tpu.server import systemdata
+        touched_ks = False
+        for m in mutations:
+            self.txn_state.apply(m)
+            touched_ks |= systemdata.mutation_overlaps(
+                m, systemdata.KEY_SERVERS_PREFIX, systemdata.KEY_SERVERS_END)
+        if touched_ks:
+            self.shards = self._shards_from_txn_state()
+        self.txn_state_version = max(self.txn_state_version, version)
 
     def die(self, reason: str):
         """The reference's commit-path contract: a proxy whose pipeline keeps
@@ -345,6 +372,8 @@ class Proxy:
     async def _commit_batch(self, batch_n: int, batch):
         requests = [req for req, _ in batch]
         replies = [rep for _, rep in batch]
+        resolution_started = False
+        state_applied = False
         try:
             # ---- Phase 1: pre-resolution (:363) ----
             await self.latest_resolving.when_at_least(batch_n - 1)
@@ -368,43 +397,99 @@ class Proxy:
                     await self.loop.delay(0.2)
             commit_version, prev_version = ver.version, ver.prev_version
 
+            from foundationdb_tpu.server import systemdata
             n_res = len(self.resolvers.endpoints)
             # per-resolver transaction lists + mapping back (transactionResolverMap)
             res_txns: list[list[TxnConflictInfo]] = [[] for _ in range(n_res)]
             txn_resolver_slots: list[list[tuple[int, int]]] = []
+            # state txns registered with EVERY resolver; mutations ride only
+            # in resolver 0's request (ResolutionRequestBuilder :307-311)
+            state_idx: list[list[int]] = [[] for _ in range(n_res)]
+            state_muts: list[list[list]] = [[] for _ in range(n_res)]
+            batch_meta: list[list | None] = []  # per request
             for req in requests:
+                meta = [m for m in req.mutations
+                        if systemdata.is_metadata_mutation(m)]
+                batch_meta.append(meta or None)
                 split_r = self.resolvers.split_ranges(req.read_conflict_ranges)
                 split_w = self.resolvers.split_ranges(req.write_conflict_ranges)
-                touched = sorted(set(split_r) | set(split_w)) or [0]
+                touched = set(split_r) | set(split_w)
+                if meta:
+                    touched |= set(range(n_res))
+                touched = sorted(touched) or [0]
                 slots = []
                 for r in touched:
-                    slots.append((r, len(res_txns[r])))
+                    idx = len(res_txns[r])
+                    slots.append((r, idx))
                     res_txns[r].append(TxnConflictInfo(
                         read_snapshot=req.read_snapshot,
                         read_ranges=split_r.get(r, []),
                         write_ranges=split_w.get(r, [])))
+                    if meta:
+                        state_idx[r].append(idx)
+                        state_muts[r].append(meta if r == 0 else [])
                 txn_resolver_slots.append(slots)
 
+            last_receive = self._last_batch_version
+            self._last_batch_version = commit_version
             resolve_futures = [
                 self.process.net.request(
                     self.process, self.resolvers.endpoints[r],
                     ResolveTransactionBatchRequest(
                         prev_version=prev_version, version=commit_version,
-                        last_receive_version=prev_version,
-                        transactions=res_txns[r]))
+                        last_receive_version=last_receive,
+                        transactions=res_txns[r],
+                        proxy_id=self.proxy_id,
+                        state_txn_indices=state_idx[r],
+                        state_txn_mutations=state_muts[r]))
                 for r in range(n_res)]
 
             # ---- Phase 2: resolution (:419) ----
+            resolution_started = True
             self.latest_resolving.set(batch_n)  # pipelining gate (:417)
             resolutions = await all_of(resolve_futures)
 
             # ---- Phase 3: post-resolution (:425) ----
             await self.latest_logging.when_at_least(batch_n - 1)
+            # FIRST: other proxies' metadata txns from the resolver replies,
+            # in version order, global verdict = AND over all resolvers'
+            # local verdicts (MasterProxyServer.actor.cpp:452-489). This must
+            # precede routing so every batch with version > V routes with
+            # the map produced by the metadata committed at V — the fence
+            # property data distribution relies on.
+            aligned = [dict(r.state_mutations or []) for r in resolutions]
+            for version, entries0 in (resolutions[0].state_mutations or []):
+                if version <= self.txn_state_version:
+                    continue  # already applied (overlapping window)
+                for r in range(1, n_res):
+                    if (version not in aligned[r]
+                            or len(aligned[r][version]) != len(entries0)):
+                        # resolvers disagree about the state txns at this
+                        # version (e.g. one lost its retained window across a
+                        # partial restart): guessing a verdict would fork
+                        # this proxy's txnStateStore from its peers' — fatal
+                        raise FDBError(
+                            "internal_error",
+                            f"resolver state windows diverge at {version}")
+                for i, (c0, muts) in enumerate(entries0):
+                    committed = c0 and all(
+                        aligned[r][version][i][0] for r in range(1, n_res))
+                    if committed:
+                        self._apply_metadata(muts, version)
+            state_applied = True
+
             statuses = []
             for slots in txn_resolver_slots:
                 # committed iff every touched resolver says committed (:492-504)
                 s = min(resolutions[r].committed[i] for r, i in slots)
                 statuses.append(s)
+
+            # own batch's committed metadata txns — ALL applied before any
+            # mutation is routed (:540 precedes the routing loop :578), so
+            # the whole batch routes with the map its own metadata produced
+            for status, meta in zip(statuses, batch_meta):
+                if status == COMMITTED and meta:
+                    self._apply_metadata(meta, commit_version)
 
             messages: dict[int, list[Mutation]] = {}
             batch_order = 0
@@ -461,7 +546,16 @@ class Proxy:
                     rep.send_error(FDBError("commit_unknown_result", detail))
             if detail != "operation_cancelled":
                 self._infra_failures += 1
-                if self.die_on_failure and self._infra_failures >= 3:
+                if self.die_on_failure and resolution_started \
+                        and not state_applied:
+                    # the resolvers recorded this batch as received (their
+                    # state-txn windows advanced past it) but we never
+                    # applied ours: the txnStateStore can no longer be
+                    # trusted. The reference's answer is the same — any
+                    # resolver failure kills the proxy and recovery rebuilds
+                    # the generation.
+                    self.die(f"state-mutation window lost: {detail}")
+                elif self.die_on_failure and self._infra_failures >= 3:
                     self.die(f"commit pipeline failing: {detail}")
 
     def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:

@@ -41,11 +41,18 @@ def new_conflict_set(oldest_version: int = 0):
 
 
 class Resolver:
-    def __init__(self, process: SimProcess, recovery_version: int = 0):
+    def __init__(self, process: SimProcess, recovery_version: int = 0,
+                 n_proxies: int = 1):
         self.process = process
+        self.n_proxies = n_proxies
         self.version = NotifiedVersion(recovery_version)
         self.conflict_set = new_conflict_set(oldest_version=recovery_version)
         self._recent_replies: dict[int, ResolveTransactionBatchReply] = {}
+        # retained state (metadata) transactions for other proxies' catch-up
+        # (Resolver.actor.cpp:59-62,170-224): version -> [(locally_committed,
+        # mutations)], pruned below the oldest proxy's received version
+        self._recent_state_txns: dict[int, list] = {}
+        self._proxy_last: dict[int, int] = {}  # proxy_id -> last version
         self.total_resolved = 0
         process.register(Token.RESOLVER_RESOLVE, self._on_resolve)
 
@@ -63,10 +70,33 @@ class Resolver:
             return
         statuses = self.conflict_set.detect(req.transactions, req.version)
         self.total_resolved += len(req.transactions)
-        r = ResolveTransactionBatchReply(committed=statuses)
+
+        # record this batch's state txns with the LOCAL verdict; proxies AND
+        # verdicts across resolvers for the global one (:452-459 in the proxy)
+        from foundationdb_tpu.ops.batch import COMMITTED
+        if req.state_txn_indices:
+            muts = req.state_txn_mutations or [[]] * len(req.state_txn_indices)
+            self._recent_state_txns[req.version] = [
+                (statuses[i] == COMMITTED, m)
+                for i, m in zip(req.state_txn_indices, muts)]
+        # hand back state txns from versions this proxy hasn't seen
+        state_out = [(v, entries)
+                     for v, entries in sorted(self._recent_state_txns.items())
+                     if req.last_receive_version < v < req.version]
+        r = ResolveTransactionBatchReply(committed=statuses,
+                                         state_mutations=state_out)
         self._recent_replies[req.version] = r
-        # prune the reply cache outside the MVCC window (reference prunes by
-        # oldest proxy version, Resolver.actor.cpp:198-224)
+        # prune: state txns below every proxy's received version; replies
+        # outside the MVCC window (reference prunes by oldestProxyVersion,
+        # Resolver.actor.cpp:198-224)
+        self._proxy_last[req.proxy_id] = req.version
+        if len(self._proxy_last) >= self.n_proxies:
+            # only once every proxy has reported (the reference's
+            # proxyInfoMap.size() == proxyCount guard): pruning earlier would
+            # drop state txns an unheard-from proxy still needs
+            oldest_proxy = min(self._proxy_last.values())
+            for v in [v for v in self._recent_state_txns if v <= oldest_proxy]:
+                del self._recent_state_txns[v]
         floor = req.version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
         for v in [v for v in self._recent_replies if v < floor]:
             del self._recent_replies[v]

@@ -467,7 +467,10 @@ class StorageServer:
                                            limit=skip + 1)
             if len(data) > skip:
                 return data[skip][0]
-            return b"\xff"  # past the end
+            # past the end: \xff\xff (the systemKeys end) — a plain \xff
+            # sentinel would sort BELOW \xff-prefixed system keys and fold
+            # system-range reads empty
+            return b"\xff\xff"
         # backward: offset <= 0 means "(1-offset)-th live key before"
         skip = -sel.offset
         end = sel.key + (b"\x00" if sel.or_equal else b"")

@@ -1,0 +1,112 @@
+"""Metadata through the commit pipeline: \\xff system keyspace, txnStateStore
+on proxies, state transactions resolved by all resolvers and applied by every
+proxy in version order.
+
+Reference: MasterProxyServer.actor.cpp:452-489,540 (state-mutation apply),
+ResolutionRequestBuilder :307-311 (state txns to all resolvers),
+Resolver.actor.cpp:170-224 (retained state txns), ApplyMetadataMutation.h,
+SystemData.cpp.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.server import systemdata
+from foundationdb_tpu.server.cluster import SimCluster
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.types import Mutation, MutationType
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+    KNOBS.reset()
+
+
+def test_txn_state_store_semantics():
+    s = systemdata.TxnStateStore([(b"\xff/a", b"1"), (b"\xff/c", b"3")])
+    s.apply(Mutation(MutationType.SET_VALUE, b"\xff/b", b"2"))
+    assert [k for k, _ in s.snapshot()] == [b"\xff/a", b"\xff/b", b"\xff/c"]
+    s.apply(Mutation(MutationType.CLEAR_RANGE, b"\xff/a", b"\xff/b\x00"))
+    assert s.snapshot() == [(b"\xff/c", b"3")]
+
+
+def test_keyservers_codec_roundtrip():
+    b = [b"", b"\x40", b"\x80"]
+    t = [[0, 1], [2], [0, 3]]
+    snap = systemdata.build_keyservers_snapshot(b, t)
+    b2, t2 = systemdata.parse_keyservers(snap)
+    assert (b2, t2) == (b, t)
+
+
+def test_metadata_txn_propagates_to_all_proxies():
+    """A \\xff/keyServers mutation committed through proxy A must reach
+    proxy B's txnStateStore (via the resolver's retained state txns) and
+    update B's routing map — in version order, before B routes any later
+    batch."""
+    c = SimCluster(seed=3, n_proxies=2, n_resolvers=2, n_tlogs=1, n_storage=2)
+    db = c.database()
+
+    async def t():
+        pa, pb = c.proxies[0], c.proxies[1]
+        # both proxies start with the same derived map
+        assert pa.shards.boundaries == pb.shards.boundaries
+
+        # commit a metadata txn through proxy A only: add boundary 0x60
+        # with the (already valid) tag of the shard it splits
+        old_tags = pa.shards.tags_for_key(b"\x60")
+        tr = db.create_transaction()
+        tr.set(systemdata.keyservers_key(b"\x60"),
+               systemdata.encode_tags(old_tags))
+        await tr.commit()
+        v_meta = tr.committed_version
+        assert b"\x60" in pa.shards.boundaries  # A applied its own batch
+
+        # drive ONE batch through proxy B explicitly: B must apply A's state
+        # mutation (from the resolver's retained window) BEFORE routing it
+        from foundationdb_tpu.core.sim import Endpoint
+        from foundationdb_tpu.server.interfaces import (
+            CommitTransactionRequest, Token)
+        client = c.net.processes["client:0"]
+        await c.net.request(
+            client, Endpoint(pb.process.address, Token.PROXY_COMMIT),
+            CommitTransactionRequest(
+                read_snapshot=v_meta, read_conflict_ranges=[],
+                write_conflict_ranges=[(b"user-key", b"user-key\x00")],
+                mutations=[Mutation(MutationType.SET_VALUE, b"user-key",
+                                    b"v")]))
+        assert b"\x60" in pb.shards.boundaries, "state txn never reached B"
+        assert pb.txn_state_version >= v_meta
+
+        # the metadata row is ALSO stored like normal data: readable
+        tr4 = db.create_transaction()
+        got = await tr4.get(systemdata.keyservers_key(b"\x60"))
+        assert got == systemdata.encode_tags(old_tags)
+
+    c.run(c.loop.spawn(t()), max_time=600.0)
+
+
+def test_metadata_txn_conflict_detection():
+    """Metadata txns are conflict-checked like any other: two txns writing
+    the same \\xff key from the same snapshot -> second conflicts."""
+    c = SimCluster(seed=4, n_proxies=1, n_resolvers=2, n_tlogs=1, n_storage=1)
+    db = c.database()
+
+    async def t():
+        k = systemdata.keyservers_key(b"\x70")
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        v1 = await tr1.get(k)
+        v2 = await tr2.get(k)
+        assert v1 is None and v2 is None
+        tr1.set(k, systemdata.encode_tags([0]))
+        tr2.set(k, systemdata.encode_tags([0]))
+        await tr1.commit()
+        from foundationdb_tpu.utils.errors import FDBError
+        with pytest.raises(FDBError) as ei:
+            await tr2.commit()
+        assert ei.value.name == "not_committed"
+
+    c.run(c.loop.spawn(t()), max_time=600.0)
