@@ -88,6 +88,19 @@ class ClusterController:
     def _on_register(self, req: RegisterWorkerRequest, reply):
         self.registry.register(req, self.loop.now())
         reply.send(None)
+        # stand-down: a storage worker that hosts no referenced tag (healed
+        # away while it was partitioned/clogged — never actually dead) must
+        # stop serving its stale ranges, or clients with stale layouts would
+        # read data missing every post-heal write. Delivered on the worker's
+        # own heartbeat, so it reaches exactly the ones that came back.
+        info = self.dbinfo
+        if ("storage" in req.roles
+                and info.recovery_state == "accepting_commits"
+                and getattr(self, "_initial_meta_done", False)
+                and req.address not in {a for a, _t in info.storages}):
+            self.net.one_way(self.process,
+                             Endpoint(req.address, Token.STORAGE_SET_SHARDS),
+                             SetShardsRequest(shard_ranges=[]))
 
     def _on_get_dbinfo(self, req, reply):
         reply.send(self.dbinfo)
@@ -559,6 +572,18 @@ class ClusterController:
 
     async def _dd_once(self):
         info = self.dbinfo
+        # reconcile first: a failed round can leave the live \xff/keyServers
+        # mid-transition (e.g. dual-routed) while dbinfo/cstate still hold
+        # the last PUBLISHED layout. Published state is the authority (an
+        # unpublished move is by definition not final and its dual-route
+        # window is safe to revert), and without this the expected-value
+        # guards in every later layout txn would wedge forever.
+        if await self._reconcile_keyservers(info):
+            return
+        # redundancy healing next (the relocation queue's highest priority,
+        # DataDistributionQueue.actor.cpp PRIORITY_TEAM_UNHEALTHY)
+        if await self._heal_once(info):
+            return
         b = list(info.shard_boundaries)
         teams = [list(t) for t in info.teams()]
         addr_of_tag = {t: a for a, t in info.storages}
@@ -656,6 +681,153 @@ class ClusterController:
             raise FDBError("operation_failed",
                            f"metadata txn failed: {e.name}") from None
 
+    async def _reconcile_keyservers(self, info) -> bool:
+        """Compare the live \\xff/keyServers rows with the published layout;
+        if they differ, write the published layout back (expected = the live
+        values just read, so a delayed ghost of this txn conflicts unless
+        nothing changed). Returns True if a corrective txn ran."""
+        from foundationdb_tpu.server import systemdata
+        db = self._dd_database()
+        await db.refresh(max_wait=5.0)
+        tr = db.create_transaction()
+        try:
+            live = await tr.get_range(systemdata.KEY_SERVERS_PREFIX,
+                                      systemdata.KEY_SERVERS_END)
+            want = systemdata.build_keyservers_snapshot(
+                list(info.shard_boundaries), [list(t) for t in info.teams()])
+            if list(live) == want:
+                return False
+            TraceEvent("DDReconcileLayout", self.process.address) \
+                .detail("Live", len(live)).detail("Want", len(want)).log()
+            tr.clear_range(systemdata.KEY_SERVERS_PREFIX,
+                           systemdata.KEY_SERVERS_END)
+            for k, v in want:
+                tr.set(k, v)
+            await tr.commit()
+            return True
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            raise FDBError("operation_failed",
+                           f"reconcile failed: {e.name}") from None
+
+    # -- redundancy healing (teamTracker DataDistribution.actor.cpp:1373 +
+    # storageServerTracker :1730): a storage server silent past the failure
+    # timeout is permanently failed; every shard it served is re-replicated
+    # onto a replacement via the normal dual-route + fetchKeys move --
+
+    async def _heal_once(self, info) -> bool:
+        from foundationdb_tpu.server import systemdata
+        now = self.loop.now()
+        alive = set(self.registry.alive(
+            "storage", now, max_age=KNOBS.DD_STORAGE_FAILURE_SECONDS))
+        addr_of_tag = {t: a for a, t in info.storages}
+        dead_tags = {t for a, t in info.storages if a not in alive}
+        teams = [list(t) for t in info.teams()]
+        b = list(info.shard_boundaries)
+        # a team needs healing if it references a dead tag OR is below the
+        # replication target (a previous heal round dropped several dead
+        # replicas but adds one replacement per round — top up until whole)
+        want = self.config.n_replicas
+        affected = [(i, t) for i, t in enumerate(teams)
+                    if any(x in dead_tags for x in t)
+                    or len([x for x in t if x not in dead_tags]) < want]
+        if not affected:
+            # GC: a dead tag referenced by NO team can be dropped — pop it
+            # on every TLog so the queue can truncate, and forget the server
+            gone = [t for t in dead_tags
+                    if not any(t in team for team in teams)]
+            if gone:
+                await self._forget_tags(info, gone)
+                return True
+            return False
+        i, team = affected[0]
+        alive_in_team = [t for t in team if t not in dead_tags]
+        if not alive_in_team:
+            TraceEvent("DDShardUnrecoverable", self.process.address,
+                       severity=40).detail("Shard", i).log()
+            return False  # every replica lost: nothing to copy from
+        lo = b[i]
+        hi = b[i + 1] if i + 1 < len(b) else None
+
+        # replacement: a spare alive storage worker (no live tag), else an
+        # alive server not already in this team
+        used = {addr_of_tag[t] for t in addr_of_tag
+                if t not in dead_tags}
+        spare = sorted(a for a in alive if a not in used)
+        new_storages = list(info.storages)
+        if spare:
+            new_tag = max((t for _a, t in info.storages), default=-1) + 1
+            epoch0 = info.log_epochs[-1].begin if info.log_epochs else 0
+            addr = (await self._recruit_many(
+                [spare[0]], 1, "storage",
+                lambda _i: {"tag": new_tag,
+                            "log_epochs": list(info.log_epochs),
+                            "recovery_count": info.epoch,
+                            "recovery_version": epoch0,
+                            "shard_ranges": []}))[0]
+            new_storages.append((addr, new_tag))
+            addr_of_tag[new_tag] = addr
+        else:
+            candidates = [t for _a, t in info.storages
+                          if t not in dead_tags and t not in team]
+            if not candidates:
+                TraceEvent("DDHealNoReplacement", self.process.address) \
+                    .detail("Shard", i).log()
+                return False
+            new_tag = candidates[0]
+        TraceEvent("DDHealShard", self.process.address) \
+            .detail("Shard", i) \
+            .detail("DeadTags", sorted(set(team) - set(alive_in_team))) \
+            .detail("NewTag", new_tag).log()
+
+        # dual-route (mutations flow to the replacement from the fence on),
+        # copy from an alive replica, then finalize the team without the
+        # dead tag — the same fenced move shards use
+        fence = await self._commit_metadata_txn(
+            info,
+            {systemdata.keyservers_key(lo): systemdata.encode_tags(team)},
+            [Mutation(MutationType.SET_VALUE, systemdata.keyservers_key(lo),
+                      systemdata.encode_tags(sorted(set(team) | {new_tag})))])
+        src = addr_of_tag[alive_in_team[0]]
+        await self.loop.timeout(self.net.request(
+            self.process, Endpoint(addr_of_tag[new_tag],
+                                   Token.STORAGE_ADD_SHARD),
+            AddShardRequest(begin=lo, end=hi, source=src,
+                            fence_version=fence)), 30.0)
+        new_team = sorted(set(alive_in_team) | {new_tag})
+        await self._commit_metadata_txn(
+            info,
+            {systemdata.keyservers_key(lo):
+                 systemdata.encode_tags(sorted(set(team) | {new_tag}))},
+            [Mutation(MutationType.SET_VALUE, systemdata.keyservers_key(lo),
+                      systemdata.encode_tags(new_team))])
+        new_teams = [list(t) for t in teams]
+        new_teams[i] = new_team
+        await self._publish_layout(b, new_teams, storages=new_storages)
+        # serving ranges for every member of the updated team
+        self._push_team_ranges(new_team, b, new_teams, addr_of_tag)
+        return True
+
+    async def _forget_tags(self, info, tags: list[int]):
+        """Drop fully-unreferenced dead tags: final TLog pops (so disk
+        queues can truncate past their backlog) + remove from the server
+        list."""
+        from foundationdb_tpu.server.interfaces import TLogPopRequest
+        for ep in info.log_epochs:
+            for j, addr in enumerate(ep.addrs):
+                for t in tags:
+                    self.net.one_way(
+                        self.process, Endpoint(addr, Token.TLOG_POP),
+                        TLogPopRequest(tag=t, version=1 << 60,
+                                       uid=ep.uid_of(j)))
+        new_storages = [(a, t) for a, t in info.storages if t not in tags]
+        TraceEvent("DDForgetTags", self.process.address) \
+            .detail("Tags", list(tags)).log()
+        await self._publish_layout(list(info.shard_boundaries),
+                                   [list(t) for t in info.teams()],
+                                   storages=new_storages)
+
     async def _merge(self, i: int):
         """Drop the boundary between shards i and i+1 (same team): one
         metadata transaction clears its \\xff/keyServers entry (every proxy
@@ -697,21 +869,24 @@ class ClusterController:
                                       Token.STORAGE_SET_SHARDS),
                              SetShardsRequest(shard_ranges=ranges))
 
-    async def _publish_layout(self, new_b, new_teams):
+    async def _publish_layout(self, new_b, new_teams, storages=None):
         """Shared publish step for every DD layout change: the coordinated
         state FIRST (a racing recovery must see a consistent layout), then
         DBInfo for clients. Aborts if the epoch moved or we were deposed."""
         info = self.dbinfo
+        if storages is None:
+            storages = info.storages
         prior, _gen = await self.cstate.read()
         if prior is None or prior.get("epoch") != info.epoch or self.deposed:
             raise FDBError("coordinators_changed", "layout changed under DD")
         prior["shard_boundaries"] = new_b
         prior["shard_tags"] = new_teams
+        prior["storages"] = [list(s) for s in storages]
         await self.cstate.write(prior)
         self.dbinfo = DBInfo(
             version=info.version + 1, epoch=info.epoch, master=info.master,
             proxies=info.proxies, resolvers=info.resolvers,
-            log_epochs=info.log_epochs, storages=info.storages,
+            log_epochs=info.log_epochs, storages=[tuple(s) for s in storages],
             shard_boundaries=new_b, recovery_state="accepting_commits",
             ratekeeper=info.ratekeeper, shard_tags=new_teams)
 

@@ -458,16 +458,22 @@ class Proxy:
             # the map produced by the metadata committed at V — the fence
             # property data distribution relies on.
             aligned = [dict(r.state_mutations or []) for r in resolutions]
+            relevant = [set(v for v in d if v > self.txn_state_version)
+                        for d in aligned]
+            if any(s != relevant[0] for s in relevant[1:]):
+                # resolvers disagree about WHICH versions carried state txns
+                # (e.g. one lost its retained window across a partial
+                # restart): guessing would fork this proxy's txnStateStore
+                # from its peers' — fatal, in either direction
+                raise FDBError(
+                    "internal_error",
+                    f"resolver state windows diverge: "
+                    f"{[sorted(s) for s in relevant]}")
             for version, entries0 in (resolutions[0].state_mutations or []):
                 if version <= self.txn_state_version:
                     continue  # already applied (overlapping window)
                 for r in range(1, n_res):
-                    if (version not in aligned[r]
-                            or len(aligned[r][version]) != len(entries0)):
-                        # resolvers disagree about the state txns at this
-                        # version (e.g. one lost its retained window across a
-                        # partial restart): guessing a verdict would fork
-                        # this proxy's txnStateStore from its peers' — fatal
+                    if len(aligned[r][version]) != len(entries0):
                         raise FDBError(
                             "internal_error",
                             f"resolver state windows diverge at {version}")
@@ -546,16 +552,17 @@ class Proxy:
                     rep.send_error(FDBError("commit_unknown_result", detail))
             if detail != "operation_cancelled":
                 self._infra_failures += 1
-                if self.die_on_failure and resolution_started \
-                        and not state_applied:
-                    # the resolvers recorded this batch as received (their
-                    # state-txn windows advanced past it) but we never
-                    # applied ours: the txnStateStore can no longer be
-                    # trusted. The reference's answer is the same — any
-                    # resolver failure kills the proxy and recovery rebuilds
-                    # the generation.
-                    self.die(f"state-mutation window lost: {detail}")
-                elif self.die_on_failure and self._infra_failures >= 3:
+                if resolution_started and not state_applied:
+                    # we never applied this batch's state-mutation window.
+                    # Rewind so the NEXT batch's window re-covers it — the
+                    # resolvers prune by ACKED last_receive_version, so the
+                    # entries are still retained. A recruited proxy whose
+                    # failures persist still dies below and the generation
+                    # is rebuilt (the reference's answer to any resolver
+                    # failure).
+                    self._last_batch_version = min(self._last_batch_version,
+                                                   self.txn_state_version)
+                if self.die_on_failure and self._infra_failures >= 3:
                     self.die(f"commit pipeline failing: {detail}")
 
     def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:

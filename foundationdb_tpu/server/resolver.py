@@ -89,7 +89,14 @@ class Resolver:
         # prune: state txns below every proxy's received version; replies
         # outside the MVCC window (reference prunes by oldestProxyVersion,
         # Resolver.actor.cpp:198-224)
-        self._proxy_last[req.proxy_id] = req.version
+        # prune by what proxies have ACKED receiving (last_receive_version =
+        # the proxy applied windows through its previous batch), not by what
+        # was merely sent to them: a proxy that lost this reply can then
+        # rewind and re-fetch its window instead of losing it to pruning.
+        # (The reference prunes by lastVersion and instead kills any proxy
+        # that misses a reply; ack-based pruning is strictly safer.)
+        self._proxy_last[req.proxy_id] = max(
+            self._proxy_last.get(req.proxy_id, 0), req.last_receive_version)
         if len(self._proxy_last) >= self.n_proxies:
             # only once every proxy has reported (the reference's
             # proxyInfoMap.size() == proxyCount guard): pruning earlier would

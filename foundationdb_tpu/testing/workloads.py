@@ -153,9 +153,10 @@ class SwizzleCloggingWorkload(Workload):
 
 class AttritionWorkload(Workload):
     """Kill/reboot transaction-subsystem processes at random intervals
-    (MachineAttrition). Storage workers only get REBOOTS (single-replica
-    data must survive); stateless/tlog workers get hard kills followed by a
-    delayed reboot so capacity returns."""
+    (MachineAttrition). With replication > 1, storage workers get HARD
+    KILLS too (stay down past the DD failure timeout, forcing redundancy
+    healing to re-replicate their shards); single-replica storage only gets
+    reboots (the data would otherwise be unrecoverable)."""
 
     name = "Attrition"
 
@@ -164,11 +165,26 @@ class AttritionWorkload(Workload):
 
     async def start(self, db):
         loop = self.cluster.loop
+        replicated = getattr(self.cluster.config, "n_replicas", 1) > 1
         while self._time_left():
             await loop.delay(self.interval * (0.5 + self.rng.random()))
             if self.rng.coinflip(0.3):
                 victim = self.cluster.storage_worker_procs[
                     self.rng.randint(0, len(self.cluster.storage_worker_procs) - 1)]
+                if replicated and self.rng.coinflip(0.5):
+                    # permanent(ish) loss: down long enough that the DD
+                    # declares the server failed and heals the teams; the
+                    # eventual reboot returns it as a spare
+                    TraceEvent("AttritionStorageKill", victim.address).log()
+                    self.cluster.net.kill(victim.address, KillType.KillProcess)
+
+                    async def reboot_much_later(addr=victim.address):
+                        await loop.delay(
+                            2.5 * KNOBS.DD_STORAGE_FAILURE_SECONDS
+                            + 10.0 * self.rng.random())
+                        self.cluster.net.reboot(addr)
+                    loop.spawn(reboot_much_later(), name="attritionSReboot")
+                    continue
                 TraceEvent("AttritionReboot", victim.address).log()
                 self.cluster.net.kill(victim.address, KillType.RebootProcess)
             else:

@@ -120,6 +120,10 @@ KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
 
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
 KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
+# a storage worker silent for this long is treated as permanently failed and
+# its shards are re-replicated onto a replacement (storageServerFailureTracker
+# / DD_FAILURE_TIME; short here because sim time is cheap)
+KNOBS.init("DD_STORAGE_FAILURE_SECONDS", 8.0, (2.0,))
 KNOBS.init("DD_SHARD_SPLIT_BYTES", 500_000, (5_000,))  # shardSplitter :314 threshold
 KNOBS.init("DD_SHARD_MERGE_BYTES", 50_000, (500,))  # shardMerger :379 threshold
 KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)

@@ -1,0 +1,91 @@
+"""Redundancy healing: a permanently failed storage server's shards are
+re-replicated onto a replacement (teamTracker DataDistribution.actor.cpp:1373,
+storageServerTracker :1730), and the cluster then passes a full replica
+consistency check.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.sim import KillType
+from foundationdb_tpu.testing.workloads import (
+    AttritionWorkload, ConsistencyCheckWorkload, CycleWorkload, run_spec)
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+    KNOBS.reset()
+
+
+def test_storage_loss_heals_and_stays_consistent():
+    """Kill one storage worker FOREVER mid-run: DD must re-replicate its
+    shards onto a replacement; the consistency check compares all replicas
+    row-for-row at the end (with the dead worker still dead)."""
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    from foundationdb_tpu.utils.rng import DeterministicRandom
+
+    KNOBS.set("DD_STORAGE_FAILURE_SECONDS", 4.0)
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    c = RecoverableCluster(seed=71, n_workers=4, n_proxies=2, n_tlogs=2,
+                           n_storage=2, n_replicas=2, n_storage_workers=5)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        # seed data across the keyspace
+        async def seed(tr):
+            for i in range(60):
+                tr.set(b"%02x-key" % i, b"val%d" % i)
+        await db.transact(seed, max_retries=500)
+
+        # kill a storage worker permanently
+        victim = c.storage_worker_procs[0].address
+        c.net.kill(victim, KillType.KillProcess)
+
+        # keep writing while the heal runs
+        for rnd in range(60):
+            async def w(tr, rnd=rnd):
+                tr.set(b"live/%03d" % rnd, b"x")
+            await db.transact(w, max_retries=500)
+            await c.loop.delay(0.3)
+            info = c.current_cc()
+            if info is None:
+                continue
+            dead_tags = {t for a, t in info.dbinfo.storages if a == victim}
+            teams = info.dbinfo.teams()
+            if dead_tags and not any(t in team for t in dead_tags
+                                     for team in teams):
+                break
+        info = c.current_cc().dbinfo
+        dead_tags = {t for a, t in info.storages if a == victim}
+        for team in info.teams():
+            assert not (dead_tags & set(team)), \
+                f"dead tag still serving: {info.teams()}"
+            assert len(team) == 2, f"replication not restored: {team}"
+
+        # every row readable; replicas identical
+        async def readall(tr):
+            return await tr.get_range(b"", b"\xff")
+        rows = await db.transact(readall, max_retries=500)
+        keys = {k for k, _v in rows}
+        for i in range(60):
+            assert b"%02x-key" % i in keys
+        w = ConsistencyCheckWorkload()
+        w.init(c, DeterministicRandom(1), stop_at=0)
+        await w.check(db)
+
+    c.run(c.loop.spawn(t()), max_time=240_000.0)
+
+
+def test_cycle_with_storage_attrition_heals():
+    """The fault-cocktail spec with HARD storage kills (replication 2):
+    serializability holds and replicas agree after healing."""
+    KNOBS.set("DD_STORAGE_FAILURE_SECONDS", 4.0)
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    r = run_spec(909, workloads=[CycleWorkload(), AttritionWorkload(),
+                                 ConsistencyCheckWorkload()],
+                 duration=45.0, buggify=False,
+                 n_replicas=2, n_storage_workers=5)
+    assert r.rotations > 0
