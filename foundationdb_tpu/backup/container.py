@@ -1,0 +1,52 @@
+"""Backup containers: where snapshot/log files land.
+
+Reference: fdbclient/BackupContainer.actor.cpp — file/blob-store abstraction
+with kvrange and log files. Here: a directory container (real files, the
+deployment path) and an in-memory container (deterministic sim tests).
+"""
+
+from __future__ import annotations
+
+import os
+
+from foundationdb_tpu.utils import wire
+
+
+class BackupContainer:
+    """In-memory container (sim tests): name -> bytes."""
+
+    def __init__(self):
+        self._files: dict[str, bytes] = {}
+
+    def write_file(self, name: str, obj) -> None:
+        self._files[name] = wire.dumps(obj)
+
+    def read_file(self, name: str):
+        return wire.loads(self._files[name])
+
+    def list_files(self, prefix: str = "") -> list[str]:
+        return sorted(n for n in self._files if n.startswith(prefix))
+
+
+class DirBackupContainer(BackupContainer):
+    """Directory-backed container (wire-encoded files on disk)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def write_file(self, name: str, obj) -> None:
+        tmp = os.path.join(self.path, name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(wire.dumps(obj))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, name))
+
+    def read_file(self, name: str):
+        with open(os.path.join(self.path, name), "rb") as f:
+            return wire.loads(f.read())
+
+    def list_files(self, prefix: str = "") -> list[str]:
+        return sorted(n for n in os.listdir(self.path)
+                      if n.startswith(prefix) and not n.endswith(".tmp"))

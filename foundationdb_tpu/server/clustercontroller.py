@@ -393,6 +393,7 @@ class ClusterController:
                     "tlogs": [Endpoint(a, Token.TLOG_COMMIT) for a in tlog_addrs],
                     "tlog_uids": list(uids),
                     "system_snapshot": list(system_snapshot),
+                    "storages": list(storages),
                     "recovery_version": start_version,
                     "epoch": epoch,
                     "other_proxies": [a for a in proxy_addrs
@@ -439,6 +440,15 @@ class ClusterController:
                                  epoch)
             await self.loop.delay(1.5 * KNOBS.MASTER_CSTATE_LEASE_SECONDS
                                   + KNOBS.PROXY_MASTER_LEASE_SECONDS)
+        # wire the DD's client to the new generation (DBInfo publishes just
+        # below; the background recovery txn and DD both use this handle)
+        self._initial_meta_done = False
+        addr_of_tag = {tag: addr for addr, tag in storages}
+        pre_db = self._dd_database()
+        pre_db.proxies = list(proxy_addrs)
+        pre_db.locations.update(
+            boundaries, [[addr_of_tag[t] for t in team]
+                         for team in shard_tags])
         self.dbinfo = DBInfo(
             version=self.dbinfo.version + 1, epoch=epoch, master=master_addr,
             proxies=proxy_addrs, resolvers=resolver_addrs,
@@ -449,12 +459,17 @@ class ClusterController:
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()
 
-        # recovery transaction: write the \xff snapshot INTO the database
-        # (the reference's recovery txn + sendInitialCommitToResolvers,
-        # masterserver.actor.cpp:597-690) — the proxies' caches were seeded
-        # directly, but DD's read-modify-write layout txns need the rows
-        # readable/conflict-checkable through the normal pipeline
-        self._initial_meta_done = False
+        # recovery transaction (the reference's recovery txn +
+        # sendInitialCommitToResolvers, masterserver.actor.cpp:597-690),
+        # run in the BACKGROUND and retried until it lands or the generation
+        # dies: it writes the keyServers snapshot INTO the database so DD's
+        # read-modify-write layout txns have rows to read (DD waits on
+        # _initial_meta_done). Blocking the publish on it would make
+        # recovery fragile under sustained clogging; the one thing that
+        # genuinely cannot wait — an in-flight backup's mutation-log tee —
+        # is instead self-seeded by each proxy from durable storage before
+        # it accepts any commit (Proxy._seed_backup_ranges), so no client
+        # write can land in an un-teed gap.
         self._watchers.append(self.process.spawn(
             self._write_initial_metadata(system_snapshot), "recoveryTxn"))
 
@@ -616,10 +631,9 @@ class ClusterController:
         deposed generation dies at its locked TLogs). DD mutations wait on
         this."""
         from foundationdb_tpu.server import systemdata
-        db = self._dd_database()
-        while not self.deposed:
+        db = self._dd_database()  # pre-wired by the recovery
+        while not self.deposed and not self._need_recovery.is_ready():
             try:
-                await db.refresh(max_wait=5.0)
                 tr = db.create_transaction()
                 tr.clear_range(systemdata.KEY_SERVERS_PREFIX,
                                systemdata.KEY_SERVERS_END)

@@ -104,7 +104,8 @@ class Proxy:
                  ratekeeper: str | None = None, n_proxies: int = 1,
                  tlog_uids: list[str] | None = None,
                  die_on_failure: bool = False,
-                 system_snapshot: list | None = None):
+                 system_snapshot: list | None = None,
+                 storages: list | None = None):
         from foundationdb_tpu.server import systemdata
         self.process = process
         self.loop = process.net.loop
@@ -126,7 +127,19 @@ class Proxy:
         self.txn_state = systemdata.TxnStateStore(system_snapshot)
         self.txn_state_version = recovery_version
         self.shards = self._shards_from_txn_state()
+        self.backup_ranges = self._backup_ranges_from_txn_state()
         self._last_batch_version = recovery_version  # own previous batch
+        # The recovery snapshot carries keyServers only; an in-flight
+        # BACKUP's tee ranges live durably in the database. A recruited
+        # proxy reads them from storage BEFORE accepting any commit (the
+        # readTransactionSystemState analogue, masterserver.actor.cpp:597):
+        # no client write can land in an un-teed gap across a recovery.
+        self._storage_addr_of_tag = {t: a for a, t in (storages or [])}
+        self._backup_seeded = storages is None  # static clusters: no seeding
+        self._seed_task = None
+        if not self._backup_seeded:
+            self._seed_task = process.spawn(self._seed_backup_ranges(),
+                                            "seedBackupRanges")
         self.other_proxies = [Endpoint(a, Token.PROXY_GET_COMMITTED_VERSION)
                               for a in (other_proxies or [])]
         self._request_num = 0
@@ -182,6 +195,8 @@ class Proxy:
     def shutdown(self):
         """Displaced by a newer generation on the same worker."""
         self._lease_task.cancel()
+        if self._seed_task is not None:
+            self._seed_task.cancel()
         if self._empty_task is not None:
             self._empty_task.cancel()
         for t in self._rk_tasks:
@@ -209,15 +224,69 @@ class Proxy:
     def _apply_metadata(self, mutations, version: int):
         """Fold committed metadata mutations into the txnStateStore and
         refresh the routing map if keyServers changed."""
+        from foundationdb_tpu.backup import agent as backup_agent
         from foundationdb_tpu.server import systemdata
         touched_ks = False
+        touched_br = False
         for m in mutations:
             self.txn_state.apply(m)
             touched_ks |= systemdata.mutation_overlaps(
                 m, systemdata.KEY_SERVERS_PREFIX, systemdata.KEY_SERVERS_END)
+            touched_br |= systemdata.mutation_overlaps(
+                m, backup_agent.RANGES_PREFIX, backup_agent.RANGES_END)
         if touched_ks:
             self.shards = self._shards_from_txn_state()
+        if touched_br:
+            self.backup_ranges = self._backup_ranges_from_txn_state()
         self.txn_state_version = max(self.txn_state_version, version)
+
+    async def _seed_backup_ranges(self):
+        """Read \\xff/backupRanges from durable storage into the
+        txnStateStore; commits are rejected until this lands (bounded only
+        by storage catch-up, which recovery requires anyway)."""
+        from foundationdb_tpu.backup import agent as backup_agent
+        from foundationdb_tpu.server.interfaces import (
+            GetKeyValuesRequest, KeySelector)
+        team = self.shards.tags_for_key(backup_agent.RANGES_PREFIX)
+        while True:
+            for tag in team:
+                addr = self._storage_addr_of_tag.get(tag)
+                if addr is None:
+                    continue
+                read_version = self.committed_version.get()
+                try:
+                    reply = await self.loop.timeout(self.process.net.request(
+                        self.process,
+                        Endpoint(addr, Token.STORAGE_GET_KEY_VALUES),
+                        GetKeyValuesRequest(
+                            begin=KeySelector.first_greater_or_equal(
+                                backup_agent.RANGES_PREFIX),
+                            end=KeySelector.first_greater_or_equal(
+                                backup_agent.RANGES_END),
+                            version=read_version)), 3.0)
+                    if self.txn_state_version > read_version:
+                        # a metadata txn (possibly a backup stop clearing
+                        # these very ranges, committed via another proxy)
+                        # was applied while the read was in flight; applying
+                        # the stale snapshot would resurrect cleared rows —
+                        # re-read at a newer version
+                        continue
+                    for k, v in reply.data:
+                        self.txn_state.set(k, v)
+                    self.backup_ranges = self._backup_ranges_from_txn_state()
+                    self._backup_seeded = True
+                    return
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+            await self.loop.delay(0.5)
+
+    def _backup_ranges_from_txn_state(self) -> list[tuple[bytes, bytes]]:
+        """Ranges the proxy tees into \\xff/blog (vecBackupKeys analogue)."""
+        from foundationdb_tpu.backup import agent as backup_agent
+        return [(k[len(backup_agent.RANGES_PREFIX):], v)
+                for k, v in self.txn_state.get_range(
+                    backup_agent.RANGES_PREFIX, backup_agent.RANGES_END)]
 
     def die(self, reason: str):
         """The reference's commit-path contract: a proxy whose pipeline keeps
@@ -346,6 +415,10 @@ class Proxy:
         if not self._master_live():
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy lost its master"))
+            return
+        if not self._backup_seeded:
+            reply.send_error(FDBError("cluster_not_fully_recovered",
+                                      "proxy still seeding txn state"))
             return
         self.stats["commits_in"] += 1
         self._pending.append((req, reply))
@@ -499,6 +572,7 @@ class Proxy:
 
             messages: dict[int, list[Mutation]] = {}
             batch_order = 0
+            blog: list[Mutation] = []  # backup tee (:664-776)
             for req, status in zip(requests, statuses):
                 if status != COMMITTED:
                     continue
@@ -512,6 +586,22 @@ class Proxy:
                         tags = self.shards.tags_for_key(m.param1)
                     for t in tags:
                         messages.setdefault(t, []).append(m)
+                    for rb_, re_ in self.backup_ranges:
+                        if systemdata.mutation_overlaps(m, rb_, re_):
+                            blog.append(m)
+                            break
+            if blog:
+                # tee into \xff/blog/<version><seq> INSIDE the same batch:
+                # the log row commits atomically with the data it records
+                from foundationdb_tpu.backup.agent import blog_key
+                from foundationdb_tpu.utils import wire as wirelib
+                for seq in range(0, len(blog), 50):
+                    bm = Mutation(
+                        MutationType.SET_VALUE,
+                        blog_key(commit_version, seq),
+                        wirelib.dumps(blog[seq:seq + 50]))
+                    for t in self.shards.tags_for_key(bm.param1):
+                        messages.setdefault(t, []).append(bm)
 
             # ---- Phase 4: logging (:835) ----
             quorum = len(self.tlogs) - KNOBS.TLOG_QUORUM_ANTIQUORUM
