@@ -282,6 +282,13 @@ class ClusterController:
             old_epochs = list(prior["log_epochs"])
             storages = list(prior["storages"])
             boundaries = list(prior["shard_boundaries"])
+            # configure-commanded txn-subsystem shape (ManagementAPI):
+            # recruit the new generation with the configured counts
+            cc_conf = prior.get("conf") or {}
+            from dataclasses import replace as _dc_replace
+            cfg = _dc_replace(cfg, **{
+                k: int(v) for k, v in cc_conf.items()
+                if k in ("n_proxies", "n_resolvers", "n_tlogs", "n_replicas")})
             recovery_version = await self._lock_old_generation(old_epochs[-1])
             # close the old generation at the recovery version
             old_epochs[-1] = LogEpoch(begin=old_epochs[-1].begin,
@@ -297,8 +304,14 @@ class ClusterController:
         # ---- RECRUITING ----
         self.dbinfo.recovery_state = "recruiting"
         now = self.loop.now()
-        stateless = self.registry.alive("stateless", now)
-        log_workers = self.registry.alive("tlog", now)
+        # excluded servers (ManagementAPI) never receive new roles; the
+        # exclusion list is mirrored into the cstate since the database is
+        # unreadable during recovery
+        excluded = set(((prior or {}).get("conf") or {}).get("excluded") or [])
+        stateless = [a for a in self.registry.alive("stateless", now)
+                     if a not in excluded]
+        log_workers = [a for a in self.registry.alive("tlog", now)
+                       if a not in excluded]
         # one resolver/proxy per worker: co-locating two same-keyed roles on
         # one process would silently displace the first (single endpoint
         # token per role kind per process)
@@ -329,7 +342,8 @@ class ClusterController:
                        "coordinators": list(self.coordinators)}))[0]
 
         if prior is None:
-            storage_workers = self.registry.alive("storage", now)
+            storage_workers = [a for a in self.registry.alive("storage", now)
+                               if a not in excluded]
             # one storage role per worker (a process has one set of STORAGE_*
             # endpoints, so co-located roles would displace each other —
             # also the reference's normal deployment shape)
@@ -413,6 +427,8 @@ class ClusterController:
             "shard_tags": shard_tags,
             "shard_boundaries": boundaries,
             "recovery_version": recovery_version,
+            # configure-commanded overrides survive further recoveries
+            "conf": (prior.get("conf") if prior else None) or {},
         })
 
         # ---- ACCEPTING_COMMITS: rebind storages, publish DBInfo ----
@@ -587,7 +603,17 @@ class ClusterController:
 
     async def _dd_once(self):
         info = self.dbinfo
-        # reconcile first: a failed round can leave the live \xff/keyServers
+        # live configuration from \xff/conf (ManagementAPI changeConfig):
+        # replication/exclusions apply through the healing machinery below;
+        # txn-subsystem shape changes trigger a recovery that re-recruits
+        # with the new counts
+        conf = await self._read_db_conf()
+        if conf is None:
+            return  # conf unreadable this round: do nothing rather than
+                    # act on boot-time defaults
+        if await self._apply_conf_shape(info, conf):
+            return
+        # reconcile next: a failed round can leave the live \xff/keyServers
         # mid-transition (e.g. dual-routed) while dbinfo/cstate still hold
         # the last PUBLISHED layout. Published state is the authority (an
         # unpublished move is by definition not final and its dual-route
@@ -597,7 +623,7 @@ class ClusterController:
             return
         # redundancy healing next (the relocation queue's highest priority,
         # DataDistributionQueue.actor.cpp PRIORITY_TEAM_UNHEALTHY)
-        if await self._heal_once(info):
+        if await self._heal_once(info, conf):
             return
         b = list(info.shard_boundaries)
         teams = [list(t) for t in info.teams()]
@@ -695,6 +721,69 @@ class ClusterController:
             raise FDBError("operation_failed",
                            f"metadata txn failed: {e.name}") from None
 
+    async def _read_db_conf(self) -> dict | None:
+        """Live \\xff/conf contents (ManagementAPI surface); None when the
+        read failed — callers must SKIP the round, not act on boot defaults
+        (falling back would e.g. shrink-team a `configure double` cluster
+        on any transient read blip)."""
+        from foundationdb_tpu.client import management
+        db = self._dd_database()
+        try:
+            return await management.get_configuration(db)
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            return None
+
+    async def _apply_conf_shape(self, info, conf: dict) -> bool:
+        """Txn-subsystem shape changes (n_proxies/n_resolvers/n_tlogs):
+        persist to the cstate and trigger a recovery that re-recruits with
+        the new counts (the reference equally restarts the transaction
+        subsystem on such configure commands). Exclusions are synced into
+        the cstate too, so recruitment (which runs while the database is
+        unreadable) honors them. Returns True if a recovery was triggered."""
+        now = self.loop.now()
+        excluded = sorted(conf.get("excluded") or [])
+        shape = {}
+        cur = {"n_proxies": len(info.proxies),
+               "n_resolvers": len(info.resolvers),
+               "n_tlogs": len(info.log_epochs[-1].addrs)
+               if info.log_epochs else 0}
+        for k in ("n_proxies", "n_resolvers", "n_tlogs"):
+            if k in conf and conf[k] != cur[k]:
+                shape[k] = conf[k]
+        want_conf = {k: v for k, v in conf.items() if k != "excluded"}
+        want_conf["excluded"] = excluded
+        if not shape and want_conf == getattr(self, "_cstate_conf", None):
+            return False
+        if shape:
+            # feasibility: a shape the registry cannot satisfy would brick
+            # the cluster (recovery fails forever; the corrective configure
+            # can never commit while recovery holds the database down)
+            avail = {
+                "n_proxies": len(self.registry.alive("stateless", now)),
+                "n_resolvers": len(self.registry.alive("stateless", now)),
+                "n_tlogs": len(self.registry.alive("tlog", now))}
+            bad = {k: v for k, v in shape.items() if v > avail[k]}
+            if bad:
+                TraceEvent("CCConfigureInfeasible", self.process.address,
+                           severity=30).detail("Requested", bad) \
+                    .detail("Available", avail).log()
+                return False
+        prior, _gen = await self.cstate.read()
+        if prior is None or prior.get("epoch") != info.epoch or self.deposed:
+            return False
+        prior["conf"] = want_conf
+        await self.cstate.write(prior)
+        self._cstate_conf = want_conf
+        if not shape:
+            return False  # exclusion sync only: no recovery needed
+        TraceEvent("CCConfigureRecovery", self.process.address) \
+            .detail("Shape", shape).log()
+        if not self._need_recovery.is_ready():
+            self._need_recovery._set(f"configure {shape}")
+        return True
+
     async def _reconcile_keyservers(self, info) -> bool:
         """Compare the live \\xff/keyServers rows with the published layout;
         if they differ, write the published layout back (expected = the live
@@ -730,19 +819,28 @@ class ClusterController:
     # timeout is permanently failed; every shard it served is re-replicated
     # onto a replacement via the normal dual-route + fetchKeys move --
 
-    async def _heal_once(self, info) -> bool:
+    async def _heal_once(self, info, conf: dict | None = None) -> bool:
         from foundationdb_tpu.server import systemdata
+        conf = conf or {}
         now = self.loop.now()
         alive = set(self.registry.alive(
             "storage", now, max_age=KNOBS.DD_STORAGE_FAILURE_SECONDS))
+        # excluded servers are drained exactly like failed ones
+        # (ManagementAPI excludeServers -> DD moves every shard off them)
+        excluded = set(conf.get("excluded") or [])
+        alive -= excluded
         addr_of_tag = {t: a for a, t in info.storages}
         dead_tags = {t for a, t in info.storages if a not in alive}
         teams = [list(t) for t in info.teams()]
         b = list(info.shard_boundaries)
-        # a team needs healing if it references a dead tag OR is below the
-        # replication target (a previous heal round dropped several dead
-        # replicas but adds one replacement per round — top up until whole)
-        want = self.config.n_replicas
+        # a team needs healing if it references a dead/excluded tag OR is
+        # off the replication target (below: top up one replacement per
+        # round; above after `configure single`: shrink one per round)
+        want = int(conf.get("n_replicas", self.config.n_replicas))
+        over = [(i, t) for i, t in enumerate(teams)
+                if not any(x in dead_tags for x in t) and len(t) > want]
+        if over:
+            return await self._shrink_team(info, over[0][0], want)
         affected = [(i, t) for i, t in enumerate(teams)
                     if any(x in dead_tags for x in t)
                     or len([x for x in t if x not in dead_tags]) < want]
@@ -823,6 +921,31 @@ class ClusterController:
         self._push_team_ranges(new_team, b, new_teams, addr_of_tag)
         return True
 
+    async def _shrink_team(self, info, i: int, want: int) -> bool:
+        """Drop one member from an over-replicated team (configure down):
+        metadata txn, publish, updated serving ranges. The dropped member's
+        tag is GC'd by _forget_tags once no team references it."""
+        from foundationdb_tpu.server import systemdata
+        teams = [list(t) for t in info.teams()]
+        b = list(info.shard_boundaries)
+        team = teams[i]
+        addr_of_tag = {t: a for a, t in info.storages}
+        new_team = sorted(team)[:want]
+        TraceEvent("DDShrinkTeam", self.process.address) \
+            .detail("Shard", i).detail("From", team).detail("To", new_team).log()
+        await self._commit_metadata_txn(
+            info,
+            {systemdata.keyservers_key(b[i]): systemdata.encode_tags(team)},
+            [Mutation(MutationType.SET_VALUE, systemdata.keyservers_key(b[i]),
+                      systemdata.encode_tags(new_team))])
+        new_teams = [list(t) for t in teams]
+        new_teams[i] = new_team
+        await self._publish_layout(b, new_teams)
+        # every old member (dropped ones included) gets its remaining
+        # assignments pushed — possibly empty (new_team is a subset of team)
+        self._push_team_ranges(sorted(set(team)), b, new_teams, addr_of_tag)
+        return True
+
     async def _forget_tags(self, info, tags: list[int]):
         """Drop fully-unreferenced dead tags: final TLog pops (so disk
         queues can truncate past their backlog) + remove from the server
@@ -870,18 +993,23 @@ class ClusterController:
         addr_of_tag = {t: a for a, t in info.storages}
         self._push_team_ranges(teams[i], new_b, new_teams, addr_of_tag)
 
-    def _team_ranges(self, team, boundaries, teams):
+    def _tag_ranges(self, tag, boundaries, teams):
+        """EVERY range `tag` serves — the union over all shards whose team
+        contains it. Teams may overlap (healing/configure reuse servers), so
+        a per-team list would clobber a member's other assignments."""
         return [(boundaries[j],
                  boundaries[j + 1] if j + 1 < len(boundaries) else None)
-                for j, t in enumerate(teams) if t == team]
+                for j, t in enumerate(teams) if tag in t]
 
     def _push_team_ranges(self, team, boundaries, teams, addr_of_tag):
-        ranges = self._team_ranges(team, boundaries, teams)
         for tag in team:
-            self.net.one_way(self.process,
-                             Endpoint(addr_of_tag[tag],
-                                      Token.STORAGE_SET_SHARDS),
-                             SetShardsRequest(shard_ranges=ranges))
+            if addr_of_tag.get(tag) is None:
+                continue
+            self.net.one_way(
+                self.process,
+                Endpoint(addr_of_tag[tag], Token.STORAGE_SET_SHARDS),
+                SetShardsRequest(
+                    shard_ranges=self._tag_ranges(tag, boundaries, teams)))
 
     async def _publish_layout(self, new_b, new_teams, storages=None):
         """Shared publish step for every DD layout change: the coordinated

@@ -57,14 +57,55 @@ class FdbCli:
     async def _cmd_help(self, args):
         for line in ("clear <KEY> — clear a key",
                      "clearrange <BEGINKEY> <ENDKEY> — clear a range",
+                     "configure [single|double|triple] [memory|ssd] "
+                     "[k=v]... — change the database configuration",
+                     "coordinators — show the coordination servers",
+                     "exclude [ADDRESS...] — exclude servers from the cluster"
+                     " (no args: list exclusions)",
                      "get <KEY> — fetch the value for a given key",
                      "getrange <BEGINKEY> [ENDKEY] [LIMIT] — fetch key/value pairs",
+                     "include <ADDRESS...|all> — re-include excluded servers",
                      "set <KEY> <VALUE> — set a value for a given key",
                      "status [json] — cluster status",
                      "writemode <on|off> — enables or disables sets and clears",
                      "help — this help",
                      "exit — exit the CLI"):
             self._print(line)
+
+    # -- management commands (ManagementAPI.actor.cpp over \xff/conf) --
+
+    async def _cmd_configure(self, args):
+        from foundationdb_tpu.client import management
+        if not args:
+            conf = await management.get_configuration(self.db)
+            self._print(json.dumps(conf, indent=2, default=str))
+            return
+        params = management.parse_configure_args(args)
+        await management.configure(self.db, **params)
+        self._print("Configuration changed")
+
+    async def _cmd_exclude(self, args):
+        from foundationdb_tpu.client import management
+        if not args:
+            for a in await management.excluded_servers(self.db):
+                self._print(a)
+            return
+        await management.exclude_servers(self.db, args)
+        self._print(f"Excluded {len(args)} server(s); the data distributor "
+                    "is draining them")
+
+    async def _cmd_include(self, args):
+        from foundationdb_tpu.client import management
+        await management.include_servers(
+            self.db, None if (not args or args == ["all"]) else args)
+        self._print("Included")
+
+    async def _cmd_coordinators(self, args):
+        coords = list(getattr(self.db, "coordinators", None) or [])
+        if not coords:
+            status = await self.db.get_status()
+            coords = status["cluster"]["coordinators"]
+        self._print("Cluster coordinators: " + " ".join(coords))
 
     async def _cmd_writemode(self, args):
         if args and args[0] == "on":

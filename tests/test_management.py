@@ -1,0 +1,163 @@
+"""Management API: configure/exclude/include as \\xff/conf transactions the
+cluster controller acts on (ManagementAPI.actor.cpp:1604; fdbcli commands
+fdbcli.actor.cpp:430-518).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.client import management
+from foundationdb_tpu.server.cluster import RecoverableCluster
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    KNOBS.set("DD_STORAGE_FAILURE_SECONDS", 4.0)
+    yield
+    KNOBS.reset()
+
+
+def test_configure_replication_live_change():
+    """`configure double` on a single-replica cluster: healing tops every
+    team up to 2; `configure single` shrinks back to 1."""
+    c = RecoverableCluster(seed=61, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=2, n_replicas=1, n_storage_workers=5)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        async def seed(tr):
+            for i in range(40):
+                tr.set(b"c%02d" % i, b"v%d" % i)
+        await db.transact(seed, max_retries=500)
+
+        await management.configure(db, n_replicas=2)
+        conf = await management.get_configuration(db)
+        assert conf["n_replicas"] == 2
+        for _ in range(120):
+            await c.loop.delay(0.5)
+            cc = c.current_cc()
+            if cc and all(len(t_) == 2 for t_ in cc.dbinfo.teams()):
+                break
+        assert all(len(t_) == 2 for t_ in c.current_cc().dbinfo.teams()), \
+            c.current_cc().dbinfo.teams()
+
+        await management.configure(db, n_replicas=1)
+        for _ in range(120):
+            await c.loop.delay(0.5)
+            cc = c.current_cc()
+            if cc and all(len(t_) == 1 for t_ in cc.dbinfo.teams()):
+                break
+        assert all(len(t_) == 1 for t_ in c.current_cc().dbinfo.teams())
+
+        # data still intact
+        async def readall(tr):
+            return await tr.get_range(b"c", b"d")
+        rows = await db.transact(readall, max_retries=500)
+        assert len(rows) == 40
+
+    c.run(c.loop.spawn(t()), max_time=300_000.0)
+
+
+def test_configure_proxies_triggers_recovery():
+    c = RecoverableCluster(seed=62, n_workers=5, n_proxies=1, n_tlogs=2,
+                           n_storage=1, n_replicas=1)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        epoch0 = c.current_cc().dbinfo.epoch
+        await management.configure(db, n_proxies=2)
+        for _ in range(120):
+            await c.loop.delay(0.5)
+            cc = c.current_cc()
+            if cc and cc.dbinfo.epoch > epoch0 \
+                    and len(cc.dbinfo.proxies) == 2:
+                break
+        info = c.current_cc().dbinfo
+        assert len(info.proxies) == 2, info.proxies
+        assert info.epoch > epoch0
+        # and the cluster still works
+        async def w(tr):
+            tr.set(b"after-configure", b"1")
+        await db.transact(w, max_retries=500)
+
+    c.run(c.loop.spawn(t()), max_time=300_000.0)
+
+
+def test_exclude_drains_server_and_include_restores():
+    """Excluding a storage worker moves every shard off it (like a failure,
+    but the server is alive the whole time); include makes it usable
+    again."""
+    c = RecoverableCluster(seed=63, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=2, n_replicas=2, n_storage_workers=5)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        async def seed(tr):
+            for i in range(40):
+                tr.set(b"e%02d" % i, b"v%d" % i)
+        await db.transact(seed, max_retries=500)
+
+        victim = c.current_cc().dbinfo.storages[0][0]
+        await management.exclude_servers(db, [victim])
+        assert victim in await management.excluded_servers(db)
+
+        for _ in range(160):
+            await c.loop.delay(0.5)
+            cc = c.current_cc()
+            if cc is None:
+                continue
+            info = cc.dbinfo
+            victim_tags = {t for a, t in info.storages if a == victim}
+            if not any(t in team for t in victim_tags
+                       for team in info.teams()):
+                break
+        info = c.current_cc().dbinfo
+        victim_tags = {t for a, t in info.storages if a == victim}
+        for team in info.teams():
+            assert not (victim_tags & set(team)), info.teams()
+            assert len(team) == 2
+
+        async def readall(tr):
+            return await tr.get_range(b"e", b"f")
+        rows = await db.transact(readall, max_retries=500)
+        assert len(rows) == 40
+
+        await management.include_servers(db, [victim])
+        assert victim not in await management.excluded_servers(db)
+
+    c.run(c.loop.spawn(t()), max_time=300_000.0)
+
+
+def test_fdbcli_management_commands():
+    from foundationdb_tpu.tools.fdbcli import FdbCli
+    c = RecoverableCluster(seed=64, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=1, n_replicas=1)
+    db = c.database()
+
+    async def warm():
+        await db.refresh()
+    c.run(c.loop.spawn(warm()), max_time=120_000.0)
+    cli = FdbCli(c, db)
+    out = cli.execute("configure double")
+    assert any("changed" in l for l in out), out
+    out = cli.execute("configure")
+    assert any('"n_replicas": 2' in l for l in out), out
+    out = cli.execute("exclude somehost:4500")
+    assert any("Excluded" in l for l in out), out
+    out = cli.execute("exclude")
+    assert out == ["somehost:4500"], out
+    out = cli.execute("include all")
+    assert any("Included" in l for l in out), out
+    out = cli.execute("exclude")
+    assert out == [], out
+    out = cli.execute("coordinators")
+    assert any("coord" in l for l in out), out
+    out = cli.execute("configure bogus=1")
+    assert any("ERROR" in l for l in out), out
