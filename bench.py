@@ -29,6 +29,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -139,7 +140,7 @@ def main():
     committed = int(comm_np.sum())
 
     txns_per_sec = total / dt
-    print(json.dumps({
+    out = {
         "metric": "resolver_conflict_txns_per_sec",
         "value": round(txns_per_sec, 1),
         "unit": "txns/s",
@@ -147,7 +148,18 @@ def main():
         "committed_frac": round(committed / total, 4),
         "batches": N_BATCHES,
         "txns_per_batch": T,
-    }))
+    }
+    # end-to-end pipeline numbers (real TCP transport, separate server
+    # processes, 100 concurrent clients — BASELINE.md's single-core
+    # methodology). Reported alongside the kernel metric; a failure to boot
+    # the subprocess cluster must not sink the kernel result.
+    if os.environ.get("FDB_TPU_BENCH_E2E", "1") != "0":
+        try:
+            import bench_e2e
+            out["e2e"] = bench_e2e.run(clients=100, seconds=4.0)
+        except Exception as e:  # noqa: BLE001
+            out["e2e_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -64,7 +64,9 @@ async def configure(db, **params) -> None:
     """changeConfig: write \\xff/conf keys transactionally."""
     for k, v in params.items():
         if k in _INT_PARAMS:
-            if not isinstance(v, int) or v < 1:
+            # bool is an int subclass: b'True' in a conf row would be
+            # unparsable for every later reader
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise FDBError("invalid_option_value", f"{k}={v}")
         elif k in _ENUM_PARAMS:
             if v not in _ENUM_PARAMS[k]:
@@ -87,13 +89,17 @@ async def get_configuration(db) -> dict:
     out: dict[str, object] = {}
     excluded = []
     for k, v in rows:
-        name = k[len(CONF_PREFIX):].decode()
+        name = k[len(CONF_PREFIX):].decode(errors="replace")
         if name.startswith("excluded/"):
             excluded.append(name[len("excluded/"):])
         elif name in _INT_PARAMS:
-            out[name] = int(v)
+            try:
+                out[name] = int(v)
+            except ValueError:
+                pass  # a corrupt row (e.g. direct \xff write) must not
+                # kill every conf reader — ignore it
         else:
-            out[name] = v.decode()
+            out[name] = v.decode(errors="replace")
     out["excluded"] = sorted(excluded)
     return out
 

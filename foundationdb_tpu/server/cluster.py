@@ -166,9 +166,10 @@ class RecoverableCluster:
         self.storage_worker_procs = [self.net.new_process(f"storagew:{i}")
                                      for i in range(n_storage_workers)]
 
-        def start_worker(proc: SimProcess):
+        def start_worker(proc: SimProcess, process_class: str = "unset"):
             proc.worker = Worker(proc, self.coordinators,
-                                 ["stateless", "tlog"])
+                                 ["stateless", "tlog"],
+                                 process_class=process_class)
 
             async def cc_candidate():
                 # tryBecomeLeader loop: whoever wins runs the CC/recovery
@@ -182,7 +183,8 @@ class RecoverableCluster:
             proc.spawn(cc_candidate(), "ccCandidate")
 
         def start_storage_worker(proc: SimProcess):
-            proc.worker = Worker(proc, self.coordinators, ["storage"])
+            proc.worker = Worker(proc, self.coordinators, ["storage"],
+                                 process_class="storage")
 
         for p in self.worker_procs:
             p.boot_fn = start_worker
@@ -195,6 +197,18 @@ class RecoverableCluster:
         proc = self.net.processes.get(name) or self.net.new_process(name)
         return Database(proc, coordinators=self.coordinators,
                         rng=self.rng.fork())
+
+    def add_worker(self, address: str, capabilities: list[str],
+                   process_class: str = "unset"):
+        """Join a new worker mid-run (tests of elasticity/preemption)."""
+        from foundationdb_tpu.server.worker import Worker
+        proc = self.net.new_process(address)
+
+        def boot(p, caps=list(capabilities), cls=process_class):
+            p.worker = Worker(p, self.coordinators, caps, process_class=cls)
+        proc.boot_fn = boot
+        boot(proc)
+        return proc
 
     def run(self, future, max_time: float = 1000.0):
         return self.loop.run_future(future, max_time=max_time)

@@ -49,18 +49,43 @@ class ClusterConfig:
     n_replicas: int = 1  # storage team size per shard (replication factor)
 
 
+# ProcessClass fitness per role (fdbrpc/Locality.h ProcessClass::machineClassFitness,
+# used by getWorkerForRoleInDatacenter ClusterController.actor.cpp:383): lower
+# is better; recruitment picks the best-ranked alive workers.
+_FITNESS = {
+    # role kind -> {process_class: rank}
+    "stateless": {"stateless": 0, "unset": 1, "transaction": 2, "storage": 3},
+    "tlog": {"transaction": 0, "unset": 1, "stateless": 2, "storage": 3},
+    "storage": {"storage": 0, "unset": 1, "transaction": 2, "stateless": 2},
+}
+
+
+def role_fitness(kind: str, process_class: str) -> int:
+    return _FITNESS[kind].get(process_class, 1)
+
+
 @dataclass
 class _Registry:
-    """Known workers: address -> (capabilities, last_seen)."""
+    """Known workers: address -> (capabilities, process_class, last_seen)."""
 
     workers: dict = field(default_factory=dict)
 
     def register(self, req: RegisterWorkerRequest, now: float):
-        self.workers[req.address] = (list(req.roles), now)
+        self.workers[req.address] = (
+            list(req.roles), getattr(req, "process_class", "unset"), now)
 
     def alive(self, capability: str, now: float, max_age: float = 3.0) -> list[str]:
-        return sorted(a for a, (caps, seen) in self.workers.items()
-                      if capability in caps and now - seen <= max_age)
+        """Alive workers with `capability`, best-fitness first (ties by
+        address for determinism) — recruitment takes from the front."""
+        fit = _FITNESS.get(capability, _FITNESS["stateless"])
+        return sorted(
+            (a for a, (caps, _cls, seen) in self.workers.items()
+             if capability in caps and now - seen <= max_age),
+            key=lambda a: (fit.get(self.workers[a][1], 1), a))
+
+    def class_of(self, address: str) -> str:
+        entry = self.workers.get(address)
+        return entry[1] if entry else "unset"
 
 
 class ClusterController:
@@ -122,8 +147,10 @@ class ClusterController:
                 "cluster_controller": self.process.address,
                 "coordinators": list(self.coordinators),
                 "workers": {
-                    a: {"roles": caps, "stale_seconds": round(now - seen, 2)}
-                    for a, (caps, seen) in sorted(self.registry.workers.items())
+                    a: {"roles": caps, "class": cls,
+                        "stale_seconds": round(now - seen, 2)}
+                    for a, (caps, cls, seen)
+                    in sorted(self.registry.workers.items())
                 },
                 "layers": {"master": info.master,
                            "proxies": list(info.proxies),
@@ -367,7 +394,9 @@ class ClusterController:
                         lambda _i, tag=tag, srange=srange: {
                             "tag": tag, "log_epochs": list(new_epochs),
                             "recovery_count": epoch,
-                            "shard_ranges": [srange]}))[0]
+                            "shard_ranges": [srange],
+                            "engine": ((prior or {}).get("conf") or {})
+                            .get("storage_engine")}))[0]
                     storages.append((addr, tag))
                     team.append(tag)
                 shard_tags.append(team)
@@ -430,6 +459,7 @@ class ClusterController:
             # configure-commanded overrides survive further recoveries
             "conf": (prior.get("conf") if prior else None) or {},
         })
+        self._cstate_conf = (prior.get("conf") if prior else None) or {}
 
         # ---- ACCEPTING_COMMITS: rebind storages, publish DBInfo ----
         for addr, _tag in storages:
@@ -494,6 +524,11 @@ class ClusterController:
         # the next recovery replaces it)
         self._watchers.append(
             self.process.spawn(self._data_distribution(), "dataDistribution"))
+        # fitness preemption (betterMasterExists, ClusterController.actor.cpp
+        # :799): when strictly better-class workers become available for the
+        # txn subsystem, one recovery migrates the roles onto them
+        self._watchers.append(self.process.spawn(
+            self._preemption_watch(epoch), "betterMasterExists"))
         # babysit the new generation (role stomps by racing recoveries,
         # self-deposed masters, and self-killed proxies are caught by the
         # epoch watchers; worker deaths by the incarnation pings)
@@ -721,6 +756,68 @@ class ClusterController:
             raise FDBError("operation_failed",
                            f"metadata txn failed: {e.name}") from None
 
+    async def _preemption_watch(self, epoch: int):
+        """Trigger ONE recovery when the current generation's txn roles
+        could be placed on strictly better-fitness workers (a degraded-but-
+        alive generation is otherwise never improved). The candidate must
+        look better across two consecutive checks so a worker mid-reboot
+        doesn't cause churn."""
+        better_streak = 0
+        while True:
+            await self.loop.delay(KNOBS.CC_PREEMPT_INTERVAL_SECONDS)
+            info = self.dbinfo
+            if (self.deposed or info.epoch != epoch
+                    or info.recovery_state != "accepting_commits"):
+                return
+            now = self.loop.now()
+
+            def current_cost(addrs, kind):
+                return sum(role_fitness(kind, self.registry.class_of(a))
+                           for a in addrs)
+
+            # recruitment skips excluded workers; a better-looking placement
+            # that needs one would churn recoveries forever
+            excluded = set(
+                (getattr(self, "_cstate_conf", None) or {}).get("excluded")
+                or [])
+
+            def best_cost(kind, families):
+                # mirror recruitment's placement exactly: each role FAMILY
+                # takes workers from the front of the fitness-ranked list
+                # independently (proxies from ranked[0..], resolvers from
+                # ranked[0..], ...), excluded workers removed
+                ranked = [a for a in self.registry.alive(
+                    "stateless" if kind == "stateless" else kind, now)
+                    if a not in excluded]
+                if not ranked:
+                    return None  # can't even re-recruit: no preemption
+                return sum(
+                    role_fitness(kind, self.registry.class_of(
+                        ranked[i % len(ranked)]))
+                    for size in families for i in range(size))
+
+            stateless_addrs = ([info.master] + list(info.proxies)
+                               + list(info.resolvers)
+                               + ([info.ratekeeper] if info.ratekeeper else []))
+            tlog_addrs = (info.log_epochs[-1].addrs if info.log_epochs else [])
+            cur = (current_cost(stateless_addrs, "stateless")
+                   + current_cost(tlog_addrs, "tlog"))
+            b_s = best_cost("stateless", [1, len(info.proxies),
+                                          len(info.resolvers),
+                                          1 if info.ratekeeper else 0])
+            b_t = best_cost("tlog", [len(tlog_addrs)])
+            if b_s is None or b_t is None or b_s + b_t >= cur:
+                better_streak = 0
+                continue
+            better_streak += 1
+            if better_streak < 2:
+                continue
+            TraceEvent("CCBetterMasterExists", self.process.address) \
+                .detail("Current", cur).detail("Best", b_s + b_t).log()
+            if not self._need_recovery.is_ready():
+                self._need_recovery._set("betterMasterExists")
+            return
+
     async def _read_db_conf(self) -> dict | None:
         """Live \\xff/conf contents (ManagementAPI surface); None when the
         read failed — callers must SKIP the round, not act on boot defaults
@@ -759,11 +856,16 @@ class ClusterController:
         if shape:
             # feasibility: a shape the registry cannot satisfy would brick
             # the cluster (recovery fails forever; the corrective configure
-            # can never commit while recovery holds the database down)
+            # can never commit while recovery holds the database down).
+            # Mirror recruitment exactly: excluded workers don't count.
+            ex = set(excluded)
+            n_stateless = len([a for a in self.registry.alive(
+                "stateless", now) if a not in ex])
             avail = {
-                "n_proxies": len(self.registry.alive("stateless", now)),
-                "n_resolvers": len(self.registry.alive("stateless", now)),
-                "n_tlogs": len(self.registry.alive("tlog", now))}
+                "n_proxies": n_stateless,
+                "n_resolvers": n_stateless,
+                "n_tlogs": len([a for a in self.registry.alive("tlog", now)
+                                if a not in ex])}
             bad = {k: v for k, v in shape.items() if v > avail[k]}
             if bad:
                 TraceEvent("CCConfigureInfeasible", self.process.address,
@@ -877,7 +979,8 @@ class ClusterController:
                             "log_epochs": list(info.log_epochs),
                             "recovery_count": info.epoch,
                             "recovery_version": epoch0,
-                            "shard_ranges": []}))[0]
+                            "shard_ranges": [],
+                            "engine": conf.get("storage_engine")}))[0]
             new_storages.append((addr, new_tag))
             addr_of_tag[new_tag] = addr
         else:

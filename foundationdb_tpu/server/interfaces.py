@@ -368,6 +368,10 @@ class InitRoleReply:
 class RegisterWorkerRequest:
     address: str
     roles: list[str]
+    # ProcessClass (fdbrpc/Locality.h): ranks this worker's fitness for each
+    # role during recruitment ("stateless" | "transaction" | "storage" |
+    # "unset")
+    process_class: str = "unset"
 
 
 @dataclass

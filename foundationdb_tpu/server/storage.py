@@ -30,6 +30,16 @@ from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.types import Mutation, MutationType
 
 _DURABLE_VERSION_KEY = "durableVersion"
+_SSD_DIR: list[str] = []
+
+
+def _default_ssd_dir() -> str:
+    """One fresh directory per interpreter run (no stale files from crashed
+    runs; SSD_DATA_DIR overrides for real deployments)."""
+    if not _SSD_DIR:
+        import tempfile
+        _SSD_DIR.append(tempfile.mkdtemp(prefix="fdbtpu-ssd-"))
+    return _SSD_DIR[0]
 
 
 class StorageServer:
@@ -38,7 +48,8 @@ class StorageServer:
                  recovery_version: int = 0,
                  log_epochs: list[LogEpoch] | None = None,
                  recovery_count: int = 0,
-                 shard_ranges: list[tuple[bytes, bytes | None]] | None = None):
+                 shard_ranges: list[tuple[bytes, bytes | None]] | None = None,
+                 engine: str | None = None):
         """Pulls its tag from the log system's epoch list (version-routed:
         epoch (begin, end] served by that generation's TLogs); pops go to
         every TLog of every epoch holding the tag.
@@ -61,9 +72,29 @@ class StorageServer:
         # serveGetValueRequests shard check).
         self.shard_ranges = shard_ranges
         self._peek_rotation = 0  # failover index within an epoch's addrs
-        self.store = MemoryKeyValueStore(
-            process.net.open_file(process, f"storage-{tag}.0"),
-            process.net.open_file(process, f"storage-{tag}.1"))
+        # engine selection (openKVStore dispatch IKeyValueStore.h:66,
+        # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
+        # WAL (kill-injected durability faults); "ssd" = host B-tree over
+        # platform SQLite on a REAL file (survives sim reboots; torn-write
+        # injection does not apply to it)
+        from foundationdb_tpu.storage.kvstore import open_kv_store
+        self.engine = engine or KNOBS.STORAGE_ENGINE
+        if self.engine == "memory":
+            self.store = open_kv_store(
+                "memory",
+                file0=process.net.open_file(process, f"storage-{tag}.0"),
+                file1=process.net.open_file(process, f"storage-{tag}.1"))
+        else:
+            import os
+            base = KNOBS.SSD_DATA_DIR or _default_ssd_dir()
+            # the network id keeps two clusters in one interpreter (or a
+            # re-run's leftovers) from recovering each other's files; same-
+            # cluster reboots share the same network and thus the same path
+            path = os.path.join(
+                base, f"fdbtpu-{id(process.net):x}"
+                      f"-{process.address.replace(':', '_')}"
+                      f"-storage-{tag}.sqlite")
+            self.store = open_kv_store(self.engine, path=path)
         self.store.recover()
         meta = self.store.get_metadata(_DURABLE_VERSION_KEY)
         self.durable_version = max(

@@ -18,10 +18,11 @@ from foundationdb_tpu.utils.errors import FDBError
 
 class Worker:
     def __init__(self, process: SimProcess, coordinators: list[str],
-                 capabilities: list[str]):
+                 capabilities: list[str], process_class: str = "unset"):
         self.process = process
         self.coordinators = coordinators
         self.capabilities = capabilities
+        self.process_class = process_class
         self.roles: dict[str, object] = {}  # "proxy:3" -> role object
         process.register(Token.WORKER_PING, self._on_ping)
         process.register(Token.WORKER_INIT_ROLE, self._on_init_role)
@@ -52,7 +53,8 @@ class Worker:
                                 Endpoint(leader, Token.CC_REGISTER_WORKER),
                                 RegisterWorkerRequest(
                                     address=self.process.address,
-                                    roles=list(self.capabilities)))
+                                    roles=list(self.capabilities),
+                                    process_class=self.process_class))
             except FDBError:
                 pass
             await net.loop.delay(1.0)

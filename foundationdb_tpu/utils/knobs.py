@@ -119,6 +119,9 @@ KNOBS.init("RK_BASE_TPS", 100_000.0)  # unthrottled budget
 KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
 
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
+KNOBS.init("CC_PREEMPT_INTERVAL_SECONDS", 5.0)  # betterMasterExists poll
+KNOBS.init("STORAGE_ENGINE", "memory")  # "memory" | "ssd" (KeyValueStoreType)
+KNOBS.init("SSD_DATA_DIR", "")  # "" -> the platform temp dir
 KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
 # a storage worker silent for this long is treated as permanently failed and
 # its shards are re-replicated onto a replacement (storageServerFailureTracker

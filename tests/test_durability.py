@@ -149,10 +149,14 @@ def _oracle_backend():
     yield
 
 
-def test_storage_server_reboot_preserves_durable_data():
-    # small MVCC window so durability advances quickly
+@pytest.mark.parametrize("engine", ["memory", "ssd"])
+def test_storage_server_reboot_preserves_durable_data(engine, tmp_path):
+    # small MVCC window so durability advances quickly; the storage role
+    # opens the configured engine via open_kv_store (IKeyValueStore.h:66)
     KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 50)
     KNOBS.set("MAX_VERSIONS_IN_FLIGHT", 1_000_000_000)
+    KNOBS.set("STORAGE_ENGINE", engine)
+    KNOBS.set("SSD_DATA_DIR", str(tmp_path))
     c = SimCluster(seed=5)
     db = c.database()
     ss_addr = c.storage_procs[0].address

@@ -1,0 +1,84 @@
+"""Fitness-based recruitment + preemption (ClusterController.actor.cpp:383
+getWorkerForRoleInDatacenter, :799 betterMasterExists).
+"""
+
+import pytest
+
+from foundationdb_tpu.server.cluster import RecoverableCluster
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    KNOBS.set("CC_PREEMPT_INTERVAL_SECONDS", 2.0)
+    yield
+    KNOBS.reset()
+
+
+def test_preemption_migrates_roles_to_better_worker():
+    """Boot with only transaction-class txn workers (degraded placement for
+    proxies/master); when a stateless-class worker joins, betterMasterExists
+    triggers ONE recovery that moves the stateless-kind roles onto it."""
+    c = RecoverableCluster(seed=81, n_workers=3, n_proxies=1, n_resolvers=1,
+                           n_tlogs=2, n_storage=1, n_replicas=1)
+    # degrade every txn worker to transaction class (they keep both
+    # capabilities, so the cluster still recovers — on poor fitness)
+    for p in c.worker_procs:
+        p.worker.process_class = "transaction"
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        info0 = c.current_cc().dbinfo
+        assert info0.master in [p.address for p in c.worker_procs]
+
+        # a better (stateless-class) worker joins
+        c.add_worker("newbie:0", ["stateless"], process_class="stateless")
+        for _ in range(60):
+            await c.loop.delay(1.0)
+            cc = c.current_cc()
+            if cc and cc.dbinfo.epoch > info0.epoch \
+                    and cc.dbinfo.master == "newbie:0":
+                break
+        info = c.current_cc().dbinfo
+        assert info.master == "newbie:0", info.master
+        assert "newbie:0" in info.proxies, info.proxies
+        # and it still works
+        async def w(tr):
+            tr.set(b"after-preempt", b"1")
+        await db.transact(w, max_retries=500)
+        # no churn: epoch advanced a bounded amount (one preemption +
+        # possibly one displacement-triggered recovery)
+        assert info.epoch <= info0.epoch + 3, info.epoch
+
+    c.run(c.loop.spawn(t()), max_time=300_000.0)
+
+
+def test_recruitment_prefers_best_class():
+    """With a mixed worker pool from the start, the stateless-kind roles
+    land on stateless-class workers and tlogs on transaction-class ones."""
+    c = RecoverableCluster(seed=82, n_workers=2, n_proxies=1, n_resolvers=1,
+                           n_tlogs=1, n_storage=1, n_replicas=1)
+    # make worker:0 transaction class and worker:1 stateless class
+    c.worker_procs[0].worker.process_class = "transaction"
+    c.worker_procs[1].worker.process_class = "stateless"
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+        # allow preemption cycles to settle placement if the initial
+        # recovery raced the class registrations
+        for _ in range(60):
+            await c.loop.delay(1.0)
+            cc = c.current_cc()
+            if (cc and cc.dbinfo.master == c.worker_procs[1].address
+                    and cc.dbinfo.log_epochs[-1].addrs
+                    == [c.worker_procs[0].address]):
+                break
+        info = c.current_cc().dbinfo
+        assert info.master == c.worker_procs[1].address, info.master
+        tlogs = info.log_epochs[-1].addrs
+        assert tlogs == [c.worker_procs[0].address], tlogs
+
+    c.run(c.loop.spawn(t()), max_time=300_000.0)
