@@ -66,13 +66,21 @@ def role_fitness(kind: str, process_class: str) -> int:
 
 @dataclass
 class _Registry:
-    """Known workers: address -> (capabilities, process_class, last_seen)."""
+    """Known workers: address -> (capabilities, process_class, last_seen),
+    plus each worker's LocalityData for policy-driven placement."""
 
     workers: dict = field(default_factory=dict)
+    localities: dict = field(default_factory=dict)
 
     def register(self, req: RegisterWorkerRequest, now: float):
+        from foundationdb_tpu.server.replication import LocalityData
         self.workers[req.address] = (
             list(req.roles), getattr(req, "process_class", "unset"), now)
+        self.localities[req.address] = LocalityData(
+            process_id=req.address,
+            zone_id=getattr(req, "zone_id", "") or req.address,
+            machine_id=getattr(req, "machine_id", "") or req.address,
+            dc_id=getattr(req, "dc_id", ""))
 
     def alive(self, capability: str, now: float, max_age: float = 3.0) -> list[str]:
         """Alive workers with `capability`, best-fitness first (ties by
@@ -86,6 +94,12 @@ class _Registry:
     def class_of(self, address: str) -> str:
         entry = self.workers.get(address)
         return entry[1] if entry else "unset"
+
+    def locality_of(self, address: str):
+        from foundationdb_tpu.server.replication import LocalityData
+        return self.localities.get(
+            address, LocalityData(process_id=address, zone_id=address,
+                                  machine_id=address))
 
 
 class ClusterController:
@@ -379,16 +393,32 @@ class ClusterController:
             # teams (DDTeamCollection :515): every shard gets n_replicas
             # storage servers on DISTINCT workers, each with its OWN tag; the
             # proxy routes each mutation to every team member's tag, so
-            # replication happens through the log, not server-to-server
+            # replication happens through the log, not server-to-server.
+            # Placement honors the replication POLICY (ReplicationPolicy.h:
+            # Across(n, zoneid) for double/triple) when worker localities
+            # allow; otherwise it degrades to distinct workers with a trace.
+            from foundationdb_tpu.server.replication import (
+                policy_for_replication, select_replicas)
+            policy = policy_for_replication(cfg.n_replicas)
             storages = []
             shard_tags: list[list[int]] = []
+            # each worker hosts at most ONE storage role (a process has one
+            # set of STORAGE_* endpoint tokens), so picked workers leave the
+            # pool; the count guard above ensures it never runs dry
+            pool = list(storage_workers)
             for i in range(cfg.n_storage):
                 srange = (boundaries[i],
                           boundaries[i + 1] if i + 1 < len(boundaries) else None)
+                cands = [(a, self.registry.locality_of(a)) for a in pool]
+                picked = select_replicas(policy, cands)
+                if picked is None or len(picked) < cfg.n_replicas:
+                    TraceEvent("CCPolicyUnsatisfiable", self.process.address,
+                               severity=30) \
+                        .detail("Policy", str(policy)).detail("Shard", i).log()
+                    picked = pool[:cfg.n_replicas]
                 team = []
-                for r in range(cfg.n_replicas):
+                for r, w in enumerate(picked[:cfg.n_replicas]):
                     tag = i * cfg.n_replicas + r
-                    w = storage_workers[tag % len(storage_workers)]
                     addr = (await self._recruit_many(
                         [w], 1, "storage",
                         lambda _i, tag=tag, srange=srange: {
@@ -399,6 +429,7 @@ class ClusterController:
                             .get("storage_engine")}))[0]
                     storages.append((addr, tag))
                     team.append(tag)
+                pool = [a for a in pool if a not in picked]
                 shard_tags.append(team)
         else:
             shard_tags = list(prior.get("shard_tags")
@@ -965,10 +996,23 @@ class ClusterController:
         hi = b[i + 1] if i + 1 < len(b) else None
 
         # replacement: a spare alive storage worker (no live tag), else an
-        # alive server not already in this team
+        # alive server not already in this team. Among spares, prefer one
+        # that keeps the team satisfying the replication policy (a zone the
+        # surviving members don't cover, ReplicationPolicy Across semantics).
+        from foundationdb_tpu.server.replication import (
+            policy_for_replication, select_replicas)
         used = {addr_of_tag[t] for t in addr_of_tag
                 if t not in dead_tags}
         spare = sorted(a for a in alive if a not in used)
+        if len(spare) > 1:
+            policy = policy_for_replication(want)
+            surviving = [(addr_of_tag[t], self.registry.locality_of(
+                addr_of_tag[t])) for t in alive_in_team]
+            best = select_replicas(
+                policy, [(a, self.registry.locality_of(a)) for a in spare],
+                already=surviving)
+            if best:
+                spare = best + [a for a in spare if a not in best]
         new_storages = list(info.storages)
         if spare:
             new_tag = max((t for _a, t in info.storages), default=-1) + 1

@@ -372,6 +372,10 @@ class RegisterWorkerRequest:
     # role during recruitment ("stateless" | "transaction" | "storage" |
     # "unset")
     process_class: str = "unset"
+    # LocalityData attributes (zone/machine default to the process itself)
+    zone_id: str = ""
+    machine_id: str = ""
+    dc_id: str = ""
 
 
 @dataclass

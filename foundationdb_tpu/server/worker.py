@@ -54,7 +54,10 @@ class Worker:
                                 RegisterWorkerRequest(
                                     address=self.process.address,
                                     roles=list(self.capabilities),
-                                    process_class=self.process_class))
+                                    process_class=self.process_class,
+                                    zone_id=self.process.machine_id,
+                                    machine_id=self.process.machine_id,
+                                    dc_id=self.process.dc_id))
             except FDBError:
                 pass
             await net.loop.delay(1.0)

@@ -1,0 +1,77 @@
+"""fdbmonitor: spawn, restart-with-backoff, conf hot-reload
+(fdbmonitor/fdbmonitor.cpp behaviors, driven against real OS processes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from foundationdb_tpu.tools.fdbmonitor import FdbMonitor
+
+
+def _write_conf(path, sections, restart_delay=0.2):
+    lines = ["[general]", f"restart_delay = {restart_delay}",
+             "restart_delay_reset = 5"]
+    for name, spec in sections.items():
+        lines += [f"[server.{name}]", f"spec = {spec}"]
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _spec_file(tmp_path, name, port, exit_after=None):
+    """A server_main-shaped spec; server_main with no roles just listens."""
+    spec = {"listen": f"127.0.0.1:{port}", "data_dir": str(tmp_path / name),
+            "knobs": {}, "roles": []}
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps(spec))
+    return str(p)
+
+
+def test_monitor_starts_restarts_and_reloads(tmp_path):
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    conf = tmp_path / "monitor.conf"
+    _write_conf(conf, {"a": _spec_file(tmp_path, "a", free_port())})
+    mon = FdbMonitor(str(conf), out=open(os.devnull, "w"))
+    try:
+        # start
+        mon.poll_once()
+        c = mon.children["server.a"]
+        assert c.proc is not None and c.proc.poll() is None
+
+        # kill it -> restart scheduled with backoff, then restarted
+        c.proc.kill()
+        c.proc.wait()
+        mon.poll_once()
+        assert c.proc is None and c.backoff > 0
+        deadline = time.time() + 10
+        while time.time() < deadline and c.proc is None:
+            time.sleep(0.1)
+            mon.poll_once()
+        assert c.proc is not None and c.proc.poll() is None, "never restarted"
+
+        # conf reload: add a second server, drop the first
+        time.sleep(0.05)
+        _write_conf(conf, {"b": _spec_file(tmp_path, "b", free_port())})
+        os.utime(conf)  # ensure mtime moves even on coarse filesystems
+        mon.poll_once()
+        assert "server.a" not in mon.children
+        assert "server.b" in mon.children
+        deadline = time.time() + 10
+        b = mon.children["server.b"]
+        while time.time() < deadline and b.proc is None:
+            time.sleep(0.1)
+            mon.poll_once()
+        assert b.proc is not None and b.proc.poll() is None
+    finally:
+        for c in list(mon.children.values()):
+            mon.stop_child(c)

@@ -1,0 +1,127 @@
+"""Replication policy engine (fdbrpc/ReplicationPolicy.h:99-127): validate +
+select_replicas over locality attributes, and policy-aware team placement in
+the cluster controller.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.server.replication import (
+    LocalityData, PolicyAcross, PolicyAnd, PolicyOne, policy_for_replication,
+    select_replicas)
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+def L(z, dc="dc0", m=None):
+    return LocalityData(process_id=f"{z}-{m or z}", zone_id=z,
+                        machine_id=m or z, dc_id=dc)
+
+
+def test_policy_validate():
+    triple = PolicyAcross(3, "zoneid")
+    assert triple.validate([L("z1"), L("z2"), L("z3")])
+    assert not triple.validate([L("z1"), L("z1"), L("z2")])
+    assert triple.validate([L("z1"), L("z1"), L("z2"), L("z3")])
+
+    two_dc = PolicyAcross(2, "dcid", PolicyAcross(2, "zoneid"))
+    assert two_dc.validate([L("z1", "dcA"), L("z2", "dcA"),
+                            L("z3", "dcB"), L("z4", "dcB")])
+    assert not two_dc.validate([L("z1", "dcA"), L("z2", "dcA"),
+                                L("z3", "dcB"), L("z3", "dcB")])
+
+    both = PolicyAnd((PolicyAcross(2, "zoneid"), PolicyAcross(2, "dcid")))
+    assert both.validate([L("z1", "dcA"), L("z2", "dcB")])
+    assert not both.validate([L("z1", "dcA"), L("z2", "dcA")])
+
+
+def test_select_replicas_prefers_distinct_zones():
+    cands = [("a", L("z1")), ("b", L("z1")), ("c", L("z2")), ("d", L("z3"))]
+    picks = select_replicas(PolicyAcross(3, "zoneid"), cands)
+    assert picks is not None
+    zones = {dict(cands)[a].zone_id for a in picks}
+    assert len(zones) == 3
+
+    # impossible: only 2 zones available
+    assert select_replicas(PolicyAcross(3, "zoneid"),
+                           [("a", L("z1")), ("b", L("z1")),
+                            ("c", L("z2"))]) is None
+
+
+def test_select_replicas_with_already():
+    cands = [("c", L("z1")), ("d", L("z2")), ("e", L("z3"))]
+    picks = select_replicas(PolicyAcross(2, "zoneid"), cands,
+                            already=[("a", L("z1"))])
+    assert picks is not None and len(picks) == 1
+    assert dict(cands)[picks[0]].zone_id != "z1"
+
+
+def test_nested_policy_selection():
+    # 2 DCs x 2 zones each
+    cands = [("a", L("z1", "dcA")), ("b", L("z2", "dcA")),
+             ("c", L("z1b", "dcA")),
+             ("d", L("z3", "dcB")), ("e", L("z4", "dcB"))]
+    pol = PolicyAcross(2, "dcid", PolicyAcross(2, "zoneid"))
+    picks = select_replicas(pol, cands)
+    assert picks is not None
+    locs = [dict(cands)[a] for a in picks]
+    assert pol.validate(locs), picks
+
+
+def test_cluster_places_teams_across_zones():
+    """Storage workers on 3 machines (2 workers each): every double-
+    replicated team must span two MACHINES (zone = machine id here), and a
+    heal after losing a worker keeps the property."""
+    from foundationdb_tpu.core.sim import KillType
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    KNOBS.set("DD_INTERVAL_SECONDS", 1.0)
+    KNOBS.set("DD_STORAGE_FAILURE_SECONDS", 4.0)
+    c = RecoverableCluster(seed=92, n_workers=4, n_proxies=1, n_tlogs=2,
+                           n_storage=2, n_replicas=2, n_storage_workers=6)
+    # co-locate storage workers pairwise on 3 machines
+    for i, p in enumerate(c.storage_worker_procs):
+        p.machine_id = f"machine{i // 2}"
+    db = c.database()
+
+    def zone_of(cc, addr):
+        return cc.registry.locality_of(addr).zone_id
+
+    async def t():
+        await db.refresh()
+        cc = c.current_cc()
+        # wait until localities registered and teams known
+        for _ in range(30):
+            await c.loop.delay(1.0)
+            cc = c.current_cc()
+            if cc and len(getattr(cc.registry, "localities", {})) >= 6:
+                break
+        info = cc.dbinfo
+        addr_of = {t_: a for a, t_ in info.storages}
+        for team in info.teams():
+            zones = {zone_of(cc, addr_of[t_]) for t_ in team}
+            assert len(zones) == 2, (team, zones)
+
+        # lose one member; the heal should pick a replacement keeping the
+        # team across two machines
+        victim = addr_of[info.teams()[0][0]]
+        c.net.kill(victim, KillType.KillProcess)
+        for _ in range(120):
+            await c.loop.delay(0.5)
+            cc = c.current_cc()
+            if cc is None:
+                continue
+            info = cc.dbinfo
+            vt = {t_ for a, t_ in info.storages if a == victim}
+            if not any(t_ in team for t_ in vt for team in info.teams()):
+                break
+        info = c.current_cc().dbinfo
+        addr_of = {t_: a for a, t_ in info.storages}
+        cc = c.current_cc()
+        for team in info.teams():
+            zones = {zone_of(cc, addr_of[t_]) for t_ in team}
+            assert len(zones) == 2, (team, zones)
+
+    c.run(c.loop.spawn(t()), max_time=240_000.0)
+    KNOBS.reset()
