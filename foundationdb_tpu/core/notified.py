@@ -42,3 +42,52 @@ class NotifiedVersion:
             _, _, f = heapq.heappop(self._waiters)
             if not f.is_ready():
                 f._set(value)
+
+
+class AsyncVar:
+    """A mutable value with change notification (flow/genericactors.actor.h
+    AsyncVar): readers `await onChange()` to observe the next set(); set with
+    an equal value does not fire (the reference's setUnconditional is
+    `set_unconditional`)."""
+
+    def __init__(self, value=None):
+        self._value = value
+        self._waiters: list[Future] = []
+
+    def get(self):
+        return self._value
+
+    def on_change(self) -> Future:
+        f = Future()
+        self._waiters.append(f)
+        return f
+
+    def set(self, value):
+        if value == self._value:
+            return
+        self.set_unconditional(value)
+
+    def set_unconditional(self, value):
+        self._value = value
+        waiters, self._waiters = self._waiters, []
+        for f in waiters:
+            f._set(value)
+
+
+class AsyncTrigger:
+    """An edge-only signal (flow/genericactors.actor.h AsyncTrigger):
+    `await on_trigger()` resumes at the NEXT trigger(); triggers with no
+    waiters are not remembered."""
+
+    def __init__(self):
+        self._waiters: list[Future] = []
+
+    def on_trigger(self) -> Future:
+        f = Future()
+        self._waiters.append(f)
+        return f
+
+    def trigger(self):
+        waiters, self._waiters = self._waiters, []
+        for f in waiters:
+            f._set(None)

@@ -409,13 +409,25 @@ class ClusterController:
             for i in range(cfg.n_storage):
                 srange = (boundaries[i],
                           boundaries[i + 1] if i + 1 < len(boundaries) else None)
-                cands = [(a, self.registry.locality_of(a)) for a in pool]
+                # balance zone consumption across shards: offer candidates
+                # from the zones with the MOST remaining workers first
+                # (stable, so fitness order survives within a zone) — a
+                # plain greedy strands small zones and forces later shards
+                # into same-zone teams that a global assignment avoids
+                zone_left: dict[str, int] = {}
+                for a in pool:
+                    z = self.registry.locality_of(a).zone_id
+                    zone_left[z] = zone_left.get(z, 0) + 1
+                ordered = sorted(
+                    pool, key=lambda a: -zone_left[
+                        self.registry.locality_of(a).zone_id])
+                cands = [(a, self.registry.locality_of(a)) for a in ordered]
                 picked = select_replicas(policy, cands)
                 if picked is None or len(picked) < cfg.n_replicas:
                     TraceEvent("CCPolicyUnsatisfiable", self.process.address,
                                severity=30) \
                         .detail("Policy", str(policy)).detail("Shard", i).log()
-                    picked = pool[:cfg.n_replicas]
+                    picked = ordered[:cfg.n_replicas]
                 team = []
                 for r, w in enumerate(picked[:cfg.n_replicas]):
                     tag = i * cfg.n_replicas + r

@@ -151,6 +151,11 @@ class Proxy:
         self._batcher_armed = False
         self._master_last_seen = self.loop.now()
         self.stats = {"commits_in": 0, "committed": 0, "conflicts": 0, "too_old": 0}
+        # latency bands + cross-process txn timeline probes (the reference's
+        # ProxyStats LatencyBands and g_traceBatch CommitDebug events)
+        from foundationdb_tpu.utils.trace import LatencyBands
+        self.commit_bands = LatencyBands(f"ProxyCommit{proxy_id}")
+        self.grv_bands = LatencyBands(f"ProxyGRV{proxy_id}")
         self._infra_failures = 0
         # suicide-on-pipeline-failure only makes sense when a cluster
         # controller exists to observe the death and rebuild the generation;
@@ -191,10 +196,15 @@ class Proxy:
             self._rk_tasks = [
                 process.spawn(self._rk_fetch_loop(), "getRate"),
                 process.spawn(self._grv_pump(), "transactionStarter")]
+        # periodic telemetry dump (the reference's traceCounters cadence):
+        # bands are useless if never emitted
+        self._bands_task = process.spawn(self._trace_bands_loop(),
+                                         "latencyBands")
 
     def shutdown(self):
         """Displaced by a newer generation on the same worker."""
         self._lease_task.cancel()
+        self._bands_task.cancel()
         if self._seed_task is not None:
             self._seed_task.cancel()
         if self._empty_task is not None:
@@ -304,6 +314,14 @@ class Proxy:
             self.process.deregister(token)
         self.shutdown()
 
+    async def _trace_bands_loop(self):
+        while True:
+            await self.loop.delay(30.0)
+            if self.commit_bands.total:
+                self.commit_bands.trace()
+            if self.grv_bands.total:
+                self.grv_bands.trace()
+
     async def _empty_batch_loop(self):
         interval = KNOBS.COMMIT_BATCH_IDLE_INTERVAL
         while True:
@@ -393,6 +411,7 @@ class Proxy:
 
     def _serve_grv(self, reply):
         if not self.other_proxies:
+            self.grv_bands.add(0.0)
             reply.send(GetReadVersionReply(version=self.committed_version.get()))
             return
         self.process.spawn(self._grv_confirm(reply), "getLiveCommittedVersion")
@@ -400,11 +419,13 @@ class Proxy:
     async def _grv_confirm(self, reply):
         """getLiveCommittedVersion (:935): a correct read version is >= every
         commit any proxy has acknowledged, so take the max over all proxies."""
+        t0 = self.loop.now()
         try:
             others = await all_of([
                 self.process.net.request(self.process, ep, None)
                 for ep in self.other_proxies])
             version = max([self.committed_version.get()] + others)
+            self.grv_bands.add(self.loop.now() - t0)
             reply.send(GetReadVersionReply(version=version))
         except FDBError as e:
             reply.send_error(e)
@@ -421,7 +442,7 @@ class Proxy:
                                       "proxy still seeding txn state"))
             return
         self.stats["commits_in"] += 1
-        self._pending.append((req, reply))
+        self._pending.append((req, reply, self.loop.now()))
         if len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX:
             self._flush()
         elif not self._batcher_armed:
@@ -440,13 +461,25 @@ class Proxy:
         self._last_flush = self.loop.now()
         self.process.spawn(self._commit_batch(self._batch_n, batch), "commitBatch")
 
+    def _band_replies(self, t_ins):
+        """Record commit latency per request, from RECEIPT (including the
+        batcher queueing delay) to reply — the reference's
+        commitLatencyBands measures the same residency."""
+        now = self.loop.now()
+        for t0 in t_ins:
+            self.commit_bands.add(now - t0)
+
     # -- the 5-phase pipeline --
 
     async def _commit_batch(self, batch_n: int, batch):
-        requests = [req for req, _ in batch]
-        replies = [rep for _, rep in batch]
+        from foundationdb_tpu.utils.trace import g_trace_batch
+        requests = [req for req, _rep, _t in batch]
+        replies = [rep for _req, rep, _t in batch]
+        t_ins = [t for _req, _rep, t in batch]
         resolution_started = False
         state_applied = False
+        g_trace_batch.add_event("CommitDebug", f"b{self.proxy_id}.{batch_n}",
+                                "Proxy.commitBatch.Before")
         try:
             # ---- Phase 1: pre-resolution (:363) ----
             await self.latest_resolving.when_at_least(batch_n - 1)
@@ -520,7 +553,13 @@ class Proxy:
             # ---- Phase 2: resolution (:419) ----
             resolution_started = True
             self.latest_resolving.set(batch_n)  # pipelining gate (:417)
+            g_trace_batch.add_event(
+                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
+                "Proxy.commitBatch.GettingCommitVersion")
             resolutions = await all_of(resolve_futures)
+            g_trace_batch.add_event(
+                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
+                "Proxy.commitBatch.AfterResolution")
 
             # ---- Phase 3: post-resolution (:425) ----
             await self.latest_logging.when_at_least(batch_n - 1)
@@ -618,6 +657,10 @@ class Proxy:
             self.latest_logging.set(batch_n)
 
             # ---- Phase 5: replies (:862) ----
+            g_trace_batch.add_event(
+                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
+                "Proxy.commitBatch.AfterLogPush")
+            self._band_replies(t_ins)
             self._infra_failures = 0
             if commit_version > self.committed_version.get():
                 self.committed_version.set(commit_version)

@@ -69,19 +69,26 @@ class FdbMonitor:
             return False
         if mtime == self._conf_mtime:
             return False
+        try:
+            cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
+            cp.read(self.conf_path)
+            if cp.has_section("general"):
+                self.restart_delay = cp.getfloat(
+                    "general", "restart_delay", fallback=self.restart_delay)
+                self.restart_delay_reset = cp.getfloat(
+                    "general", "restart_delay_reset",
+                    fallback=self.restart_delay_reset)
+            wanted: dict[str, str] = {}
+            for section in cp.sections():
+                if section.startswith("server."):
+                    wanted[section] = cp.get(section, "spec")
+        except (configparser.Error, ValueError) as e:
+            # a conf typo must never take down the supervised processes:
+            # keep the running config (and keep the old mtime, so a fixed
+            # file is picked up; an unchanged broken file just re-logs)
+            self.log("ConfLoadFailed", error=str(e))
+            return False
         self._conf_mtime = mtime
-        cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
-        cp.read(self.conf_path)
-        if cp.has_section("general"):
-            self.restart_delay = cp.getfloat(
-                "general", "restart_delay", fallback=self.restart_delay)
-            self.restart_delay_reset = cp.getfloat(
-                "general", "restart_delay_reset",
-                fallback=self.restart_delay_reset)
-        wanted: dict[str, str] = {}
-        for section in cp.sections():
-            if section.startswith("server."):
-                wanted[section] = cp.get(section, "spec")
         # stop removed/changed sections; start new ones
         for sec in list(self.children):
             if sec not in wanted or self.children[sec].spec_path != wanted[sec]:
@@ -147,7 +154,10 @@ class FdbMonitor:
         signal.signal(signal.SIGINT, on_term)
         try:
             while not self._stopping:
-                self.poll_once()
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — supervisor survives
+                    self.log("PollFailed", error=repr(e))
                 time.sleep(poll_interval)
         finally:
             for c in self.children.values():

@@ -59,6 +59,9 @@ class TraceEvent:
     def log(self):
         if self._sev < _min_severity:
             return
+        if (_suppression is not None and self._sev < SevError
+                and not _suppression.admit(self._fields)):
+            return  # rate-suppressed (errors always pass)
         if _sink is not None:
             _sink(self._fields)
         else:
@@ -79,4 +82,165 @@ class CounterCollection:
         ev = TraceEvent(f"{self.name}Metrics")
         for k, v in sorted(self.counters.items()):
             ev.detail(k, v)
+        ev.log()
+
+
+class RollingTraceFile:
+    """Rolling trace sink (flow/Trace.h:260 openTraceFile): JSON lines into
+    `path`, rolled to `path.<n>` when `roll_bytes` is exceeded, keeping the
+    newest `keep` rolls. Install with set_sink(rt.write)."""
+
+    def __init__(self, path: str, roll_bytes: int = 10_000_000, keep: int = 10):
+        import os
+        self.path = path
+        self.roll_bytes = roll_bytes
+        self.keep = keep
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def write(self, fields: dict):
+        self._f.write(json.dumps(fields, default=str) + "\n")
+        if self._f.tell() >= self.roll_bytes:
+            self.roll()
+
+    def roll(self):
+        import os
+        self._f.close()
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", buffering=1)
+
+    def close(self):
+        self._f.close()
+
+
+class _Suppression:
+    """Per-type rate suppression (Trace.cpp's suppressFor): at most `limit`
+    events of one Type per `interval` seconds; excess is counted and
+    surfaced once per interval as a Suppressed event."""
+
+    def __init__(self, limit: int = 100, interval: float = 5.0):
+        self.limit = limit
+        self.interval = interval
+        self._windows: dict[str, tuple[float, int, int]] = {}
+
+    def admit(self, fields: dict) -> bool:
+        ty = fields.get("Type", "")
+        now = fields.get("Time", 0.0)
+        start, n, dropped = self._windows.get(ty, (now, 0, 0))
+        if now - start >= self.interval:
+            if dropped:
+                emit = {"Type": "TraceEventsSuppressed", "Time": now,
+                        "OfType": ty, "Dropped": dropped}
+                if _sink is not None:
+                    _sink(emit)
+                else:
+                    print(json.dumps(emit), file=sys.stderr)
+            start, n, dropped = now, 0, 0
+        if n >= self.limit:
+            self._windows[ty] = (start, n, dropped + 1)
+            return False
+        self._windows[ty] = (start, n + 1, dropped)
+        return True
+
+
+_suppression: _Suppression | None = None
+
+
+def enable_suppression(limit: int = 100, interval: float = 5.0):
+    global _suppression
+    _suppression = _Suppression(limit, interval)
+
+
+def flush_suppressed():
+    """Emit pending Dropped counts (a chatty type that went quiet would
+    otherwise never surface its final window's suppression)."""
+    if _suppression is None:
+        return
+    for ty, (start, _n, dropped) in list(_suppression._windows.items()):
+        if dropped:
+            emit = {"Type": "TraceEventsSuppressed", "Time": _now(),
+                    "OfType": ty, "Dropped": dropped}
+            if _sink is not None:
+                _sink(emit)
+            else:
+                print(json.dumps(emit), file=sys.stderr)
+    _suppression._windows.clear()
+
+
+def disable_suppression():
+    global _suppression
+    flush_suppressed()
+    _suppression = None
+
+
+class TraceBatch:
+    """g_traceBatch (flow/Trace.h): micro-timing attach/event records that
+    stitch ONE transaction's timeline across processes — the commit path
+    emits `addEvent("CommitDebug", id, "Proxy.commitBatch.Before")`-style
+    probes (NativeAPI.actor.cpp:2689, MasterProxyServer.actor.cpp:356,
+    Resolver.actor.cpp:83). Buffered; dump() flushes to the trace log."""
+
+    def __init__(self, max_buffer: int = 4096):
+        self.max_buffer = max_buffer
+        self._events: list[dict] = []
+
+    def add_event(self, kind: str, ident, location: str):
+        self._events.append({"Type": kind, "Time": round(_now(), 6),
+                             "ID": str(ident), "Location": location})
+        if len(self._events) >= self.max_buffer:
+            self.dump()
+
+    def add_attach(self, kind: str, ident, to: str):
+        """Link two ids (e.g. a transaction to its commit batch)."""
+        self._events.append({"Type": kind, "Time": round(_now(), 6),
+                             "ID": str(ident), "To": str(to)})
+
+    def dump(self):
+        events, self._events = self._events, []
+        for e in events:
+            if _sink is not None:
+                _sink(e)
+            else:
+                print(json.dumps(e, default=str), file=sys.stderr)
+
+    def timeline(self, ident) -> list[dict]:
+        """Buffered records for one id (tests/debugging)."""
+        return [e for e in self._events if e.get("ID") == str(ident)]
+
+
+g_trace_batch = TraceBatch()
+
+
+class LatencyBands:
+    """Latency histogram traced alongside counters (the reference's
+    LatencyBands in Stats.h / proxy GRV+commit bands): fixed upper-bound
+    bands in seconds, counts per band."""
+
+    BANDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0)
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counts = [0] * (len(self.BANDS) + 1)
+        self.total = 0
+        self.max_seen = 0.0
+
+    def add(self, seconds: float):
+        from bisect import bisect_left
+        self.counts[bisect_left(self.BANDS, seconds)] += 1
+        self.total += 1
+        self.max_seen = max(self.max_seen, seconds)
+
+    def trace(self):
+        ev = TraceEvent(f"{self.name}LatencyBands")
+        for bound, n in zip(self.BANDS, self.counts):
+            if n:
+                ev.detail(f"le_{bound}", n)
+        if self.counts[-1]:
+            ev.detail("gt_last", self.counts[-1])
+        ev.detail("Total", self.total).detail("Max", round(self.max_seen, 6))
         ev.log()

@@ -1,0 +1,141 @@
+"""Auxiliary observability: rolling trace files, rate suppression,
+g_traceBatch txn timelines, latency bands, AsyncVar/AsyncTrigger.
+
+Reference: flow/Trace.cpp (rolling + suppression), flow/Trace.h g_traceBatch,
+flow/Stats.h LatencyBands, flow/genericactors.actor.h AsyncVar/AsyncTrigger.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.utils import trace as T
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    yield
+    T.set_sink(None)
+    T.disable_suppression()
+
+
+def test_rolling_trace_file(tmp_path):
+    path = str(tmp_path / "trace.log")
+    rt = T.RollingTraceFile(path, roll_bytes=500, keep=3)
+    T.set_sink(rt.write)
+    for i in range(100):
+        T.TraceEvent("RollMe").detail("I", i).log()
+    rt.close()
+    rolls = [f for f in os.listdir(tmp_path) if f.startswith("trace.log.")]
+    assert rolls, "never rolled"
+    assert len(rolls) <= 3
+    # every kept file parses as JSON lines
+    for name in rolls + ["trace.log"]:
+        for line in open(tmp_path / name):
+            json.loads(line)
+
+
+def test_suppression_limits_and_reports(tmp_path):
+    got: list[dict] = []
+    T.set_sink(got.append)
+    T.enable_suppression(limit=5, interval=1000.0)
+    for _ in range(50):
+        T.TraceEvent("Chatty").log()
+    T.TraceEvent("Rare").log()
+    # errors always pass
+    for _ in range(10):
+        T.TraceEvent("Bad", severity=T.SevError).log()
+    chatty = [e for e in got if e["Type"] == "Chatty"]
+    assert len(chatty) == 5
+    assert len([e for e in got if e["Type"] == "Rare"]) == 1
+    assert len([e for e in got if e["Type"] == "Bad"]) == 10
+
+
+def test_trace_batch_timeline():
+    tb = T.TraceBatch()
+    tb.add_event("CommitDebug", "txn1", "Native.commit.Before")
+    tb.add_event("CommitDebug", "txn2", "Native.commit.Before")
+    tb.add_event("CommitDebug", "txn1", "Proxy.commitBatch.AfterResolution")
+    tl = tb.timeline("txn1")
+    assert [e["Location"] for e in tl] == [
+        "Native.commit.Before", "Proxy.commitBatch.AfterResolution"]
+    got: list[dict] = []
+    T.set_sink(got.append)
+    tb.dump()
+    assert len(got) == 3 and tb.timeline("txn1") == []
+
+
+def test_latency_bands():
+    lb = T.LatencyBands("X")
+    for s in (0.0005, 0.003, 0.003, 0.2, 9.0):
+        lb.add(s)
+    got: list[dict] = []
+    T.set_sink(got.append)
+    lb.trace()
+    ev = got[0]
+    assert ev["Type"] == "XLatencyBands"
+    assert ev["Total"] == 5
+    assert ev["le_0.001"] == 1
+    assert ev["le_0.005"] == 2
+    assert ev["gt_last"] == 1
+
+
+def test_async_var_and_trigger():
+    from foundationdb_tpu.core.eventloop import EventLoop
+    from foundationdb_tpu.core.notified import AsyncTrigger, AsyncVar
+
+    loop = EventLoop()
+    av = AsyncVar(1)
+    trig = AsyncTrigger()
+    seen = []
+
+    async def watcher():
+        seen.append(await av.on_change())
+        await trig.on_trigger()
+        seen.append("triggered")
+
+    async def driver():
+        av.set(1)  # no-op: equal value must not fire
+        await loop.delay(0.01)
+        av.set(2)
+        await loop.delay(0.01)
+        trig.trigger()
+        await loop.delay(0.01)
+        trig.trigger()  # no waiter: forgotten, not queued
+
+    t1 = loop.spawn(watcher(), name="w")
+    t2 = loop.spawn(driver(), name="d")
+    loop.run_future(t2, max_time=10.0)
+    assert seen == [2, "triggered"]
+    assert av.get() == 2
+
+
+def test_proxy_emits_bands_and_probes():
+    """The live proxy records commit/GRV latency bands and CommitDebug
+    timeline probes."""
+    from foundationdb_tpu.server.cluster import SimCluster
+    from foundationdb_tpu.utils.knobs import KNOBS
+
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    c = SimCluster(seed=2, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1)
+    db = c.database()
+
+    async def t():
+        for i in range(5):
+            tr = db.create_transaction()
+            await tr.get(b"k%d" % i)  # forces a GRV
+            tr.set(b"k%d" % i, b"v")
+            await tr.commit()
+    c.run(c.loop.spawn(t()), max_time=600.0)
+    p = c.proxies[0]
+    assert p.commit_bands.total >= 5
+    assert p.grv_bands.total >= 1
+    probes = [e for e in T.g_trace_batch._events
+              if e["Type"] == "CommitDebug"]
+    assert any(e["Location"] == "Proxy.commitBatch.AfterLogPush"
+               for e in probes)
+    T.g_trace_batch.dump()
+    KNOBS.reset()
