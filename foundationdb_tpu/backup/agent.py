@@ -61,7 +61,6 @@ class BackupAgent:
         self.container = container
         self.chunks = chunks
         self.tasks = TaskBucket(db)
-        self._log_n = 0
 
     async def start(self, begin: bytes = b"", end: bytes = b"\xff"):
         """Activate the proxies' tee and enqueue snapshot-chunk tasks (one
@@ -129,10 +128,15 @@ class BackupAgent:
                 tr.clear_range(BLOG_PREFIX, rows[-1][0] + b"\x00")
         await self.db.transact(body, max_retries=200)
         if rows:
-            self._log_n += 1
             entries = [(parse_blog_key(k), v) for k, v in rows]
+            # file name = the drained version range: unique across agents
+            # (a stop() racing a tailer must not overwrite its files) and
+            # lexicographically version-ordered
+            first = entries[0][0]
+            last = entries[-1][0]
             self.container.write_file(
-                "log-%08d" % self._log_n,
+                "log-%016x.%08x-%016x.%08x" % (first[0], first[1],
+                                               last[0], last[1]),
                 [((v, s), payload) for (v, s), payload in entries])
         return len(rows)
 

@@ -1,0 +1,82 @@
+"""Simulation-only global invariant oracles.
+
+Reference: fdbrpc/sim_validation.cpp — tiny global trackers called from REAL
+code paths (e.g. debug_advanceMaxCommittedVersion from the proxy,
+MasterProxyServer.actor.cpp:820) that ASSERT cross-process invariants the
+distributed protocol is supposed to guarantee. They only observe under the
+deterministic simulator (a real deployment has no global vantage point) and
+cost nothing when disabled.
+
+Invariants tracked:
+  - acked-commit monotonicity: the set of client-ACKNOWLEDGED commit
+    versions is consistent with the master's total order (a new ack below
+    an already-acked version is fine — acks race — but a version can never
+    be acked twice from different batches).
+  - external consistency: a read version HANDED OUT must be >= every commit
+    acknowledged before the GRV request was received (strict
+    serializability's real-time edge; debug_checkMinCommittedVersion).
+"""
+
+from __future__ import annotations
+
+_enabled = False
+_max_acked = 0
+_acked_from: dict[int, str] = {}
+
+
+def enable():
+    """Turned on by the simulator; real deployments never call this."""
+    global _enabled, _max_acked
+    _enabled = True
+    _max_acked = 0
+    _acked_from.clear()
+
+
+def reset():
+    global _max_acked
+    _max_acked = 0
+    _acked_from.clear()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def debug_advance_max_committed(version: int, who: str = "?"):
+    """Called by a proxy when it ACKS a commit at `version` to a client
+    (debug_advanceMaxCommittedVersion). Each version is acked by exactly one
+    batch on one proxy; a duplicate ack from elsewhere means two batches
+    believed they owned the same master-assigned version."""
+    global _max_acked
+    if not _enabled:
+        return
+    prev = _acked_from.get(version)
+    assert prev is None or prev == who, \
+        f"version {version} acked by both {prev} and {who}"
+    _acked_from[version] = who
+    if version > _max_acked:
+        _max_acked = version
+    # bound memory AND work: over the cap, drop the oldest half by version
+    # (a fixed version-distance window prunes nothing when versions advance
+    # slowly, turning long dense sims quadratic)
+    if len(_acked_from) > 65536:
+        keep = sorted(_acked_from)[len(_acked_from) // 2:]
+        kept = {v: _acked_from[v] for v in keep}
+        _acked_from.clear()
+        _acked_from.update(kept)
+
+
+def debug_grv_floor() -> int:
+    """Snapshot the external-consistency floor when a GRV request ARRIVES:
+    the reply must be >= this (every commit acked before the request)."""
+    return _max_acked if _enabled else 0
+
+
+def debug_check_read_version(version: int, floor: int, who: str = "?"):
+    """Called with the GRV reply and the floor snapshotted at arrival
+    (debug_checkMinCommittedVersion): handing out less would let a client
+    miss a write it was already told succeeded."""
+    if not _enabled:
+        return
+    assert version >= floor, \
+        f"{who} handed out read version {version} < acked floor {floor}"

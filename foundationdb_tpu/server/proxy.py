@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from foundationdb_tpu.core import sim_validation
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.ops.batch import (
@@ -410,13 +411,18 @@ class Proxy:
         self._serve_grv(reply)
 
     def _serve_grv(self, reply):
+        floor = sim_validation.debug_grv_floor()
         if not self.other_proxies:
             self.grv_bands.add(0.0)
-            reply.send(GetReadVersionReply(version=self.committed_version.get()))
+            v = self.committed_version.get()
+            sim_validation.debug_check_read_version(
+                v, floor, self.process.address)
+            reply.send(GetReadVersionReply(version=v))
             return
-        self.process.spawn(self._grv_confirm(reply), "getLiveCommittedVersion")
+        self.process.spawn(self._grv_confirm(reply, floor),
+                           "getLiveCommittedVersion")
 
-    async def _grv_confirm(self, reply):
+    async def _grv_confirm(self, reply, floor: int = 0):
         """getLiveCommittedVersion (:935): a correct read version is >= every
         commit any proxy has acknowledged, so take the max over all proxies."""
         t0 = self.loop.now()
@@ -426,6 +432,10 @@ class Proxy:
                 for ep in self.other_proxies])
             version = max([self.committed_version.get()] + others)
             self.grv_bands.add(self.loop.now() - t0)
+            # external consistency oracle: >= every commit acked before the
+            # GRV arrived (debug_checkMinCommittedVersion)
+            sim_validation.debug_check_read_version(
+                version, floor, self.process.address)
             reply.send(GetReadVersionReply(version=version))
         except FDBError as e:
             reply.send_error(e)
@@ -664,9 +674,11 @@ class Proxy:
             self._infra_failures = 0
             if commit_version > self.committed_version.get():
                 self.committed_version.set(commit_version)
+            acked_any = False
             for rep, status in zip(replies, statuses):
                 if status == COMMITTED:
                     self.stats["committed"] += 1
+                    acked_any = True
                     rep.send(CommitReply(version=commit_version))
                 elif status == TOO_OLD:
                     self.stats["too_old"] += 1
@@ -674,6 +686,12 @@ class Proxy:
                 else:
                     self.stats["conflicts"] += 1
                     rep.send_error(FDBError("not_committed"))
+            if acked_any:
+                # sim-only oracle (debug_advanceMaxCommittedVersion,
+                # MasterProxyServer.actor.cpp:820): acked versions are
+                # unique per batch, and every later GRV must be >= this
+                sim_validation.debug_advance_max_committed(
+                    commit_version, f"{self.process.address}/b{batch_n}")
         except Exception as e:  # noqa: BLE001
             # a failed stage fails the whole batch; clients retry
             # (commit_unknown_result semantics: the batch may have logged)

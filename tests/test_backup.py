@@ -202,3 +202,45 @@ def test_backup_survives_recovery_midstream():
 
     got = dst.run(dst.loop.spawn(r()), max_time=600_000.0)
     assert got == truth
+
+
+def test_fdbbackup_cli_commands(tmp_path):
+    """fdbbackup start/status/stop + fdbrestore over a directory container
+    (backup.actor.cpp's operator surface)."""
+    from foundationdb_tpu.tools import fdbbackup as B
+
+    src = SimCluster(seed=21, n_proxies=1, n_resolvers=1, n_tlogs=1,
+                     n_storage=1)
+    db = src.database()
+    d = str(tmp_path / "container")
+
+    async def t():
+        async def seed(tr):
+            for i in range(20):
+                tr.set(b"b%02d" % i, b"v%d" % i)
+        await db.transact(seed, max_retries=200)
+        assert "no backup" in await B.run_command(db, ["status"])
+        out = await B.run_command(db, ["start", "-d", d])
+        assert "snapshot complete" in out
+        assert "state: active" in await B.run_command(db, ["status"])
+        async def more(tr):
+            tr.set(b"b99", b"late")
+        await db.transact(more, max_retries=200)
+        out = await B.run_command(db, ["stop", "-d", d])
+        assert "restorable" in out
+        assert "state: stopped" in await B.run_command(db, ["status"])
+    src.run(src.loop.spawn(t()), max_time=600_000.0)
+
+    dst = SimCluster(seed=22, n_proxies=1, n_resolvers=1, n_tlogs=1,
+                     n_storage=1)
+    db2 = dst.database()
+
+    async def r():
+        await B.run_command(db2, ["restore", "-d", d])
+        async def readall(tr):
+            return await tr.get_range(b"", b"\xff")
+        return _user_rows(await db2.transact(readall, max_retries=200))
+    rows = dst.run(dst.loop.spawn(r()), max_time=600_000.0)
+    keys = dict(rows)
+    assert keys.get(b"b99") == b"late"
+    assert len([k for k in keys if k.startswith(b"b")]) == 21
