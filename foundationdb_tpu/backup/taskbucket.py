@@ -43,15 +43,22 @@ class TaskBucket:
         agents racing on the same row conflict, so exactly one wins."""
         async def body(tr):
             now = self.loop.now()
-            rows = await tr.get_range(PREFIX, END, limit=20)
-            for k, v in rows:
-                obj = wire.loads(v)
-                if obj["lease"] < now:
-                    tr.set(k, wire.dumps({
-                        "task": obj["task"],
-                        "lease": now + self.lease_seconds}))
-                    return k, obj["task"]
-            return None
+            # page past live-leased rows: an expired task beyond the first
+            # page must still be reclaimable (liveness), so keep scanning to
+            # the end of the range, 20 rows at a time
+            begin = PREFIX
+            while True:
+                rows = await tr.get_range(begin, END, limit=20)
+                for k, v in rows:
+                    obj = wire.loads(v)
+                    if obj["lease"] < now:
+                        tr.set(k, wire.dumps({
+                            "task": obj["task"],
+                            "lease": now + self.lease_seconds}))
+                        return k, obj["task"]
+                if len(rows) < 20:
+                    return None
+                begin = rows[-1][0] + b"\x00"
         return await self.db.transact(body, max_retries=100)
 
     async def extend(self, key: bytes):

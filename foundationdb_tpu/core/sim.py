@@ -132,10 +132,11 @@ class SimNetwork:
         self.processes: dict[str, SimProcess] = {}
         self._clogged_until: dict[tuple[str, str], float] = {}
         self._partitioned: set[tuple[str, str]] = set()
-        # global invariant oracles observe only under simulation
+        # invariant oracles observe only under simulation, with state scoped
+        # to THIS network so coexisting sims can't mix acked versions
         # (fdbrpc/sim_validation.cpp pattern)
         from foundationdb_tpu.core import sim_validation
-        sim_validation.enable()
+        self.validation = sim_validation.SimValidation()
         self._next_token = 1 << 32
         # reply futures currently owed by each serving process, so a kill can
         # break them (TOKEN_IGNORE / broken_promise semantics)

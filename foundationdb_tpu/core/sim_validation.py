@@ -7,6 +7,11 @@ distributed protocol is supposed to guarantee. They only observe under the
 deterministic simulator (a real deployment has no global vantage point) and
 cost nothing when disabled.
 
+Unlike the reference (one process = one simulation, so globals are safe),
+several simulated clusters can coexist in one interpreter here, so the
+oracle state is attached to each SimNetwork instance; `of(net)` resolves a
+network to its oracle, or to a no-op for real transports.
+
 Invariants tracked:
   - acked-commit monotonicity: the set of client-ACKNOWLEDGED commit
     versions is consistent with the master's total order (a new ack below
@@ -19,64 +24,70 @@ Invariants tracked:
 
 from __future__ import annotations
 
-_enabled = False
-_max_acked = 0
-_acked_from: dict[int, str] = {}
+
+class SimValidation:
+    """Per-simulation oracle state (one per SimNetwork)."""
+
+    enabled = True
+
+    def __init__(self):
+        self._max_acked = 0
+        self._acked_from: dict[int, str] = {}
+
+    def debug_advance_max_committed(self, version: int, who: str = "?"):
+        """Called by a proxy when it ACKS a commit at `version` to a client
+        (debug_advanceMaxCommittedVersion). Each version is acked by exactly
+        one batch on one proxy; a duplicate ack from elsewhere means two
+        batches believed they owned the same master-assigned version."""
+        prev = self._acked_from.get(version)
+        assert prev is None or prev == who, \
+            f"version {version} acked by both {prev} and {who}"
+        self._acked_from[version] = who
+        if version > self._max_acked:
+            self._max_acked = version
+        # bound memory AND work: over the cap, drop the oldest half by
+        # version (a fixed version-distance window prunes nothing when
+        # versions advance slowly, turning long dense sims quadratic)
+        if len(self._acked_from) > 65536:
+            keep = sorted(self._acked_from)[len(self._acked_from) // 2:]
+            kept = {v: self._acked_from[v] for v in keep}
+            self._acked_from.clear()
+            self._acked_from.update(kept)
+
+    def debug_grv_floor(self) -> int:
+        """Snapshot the external-consistency floor when a GRV request
+        ARRIVES: the reply must be >= this (every commit acked before the
+        request)."""
+        return self._max_acked
+
+    def debug_check_read_version(self, version: int, floor: int,
+                                 who: str = "?"):
+        """Called with the GRV reply and the floor snapshotted at arrival
+        (debug_checkMinCommittedVersion): handing out less would let a
+        client miss a write it was already told succeeded."""
+        assert version >= floor, \
+            f"{who} handed out read version {version} < acked floor {floor}"
 
 
-def enable():
-    """Turned on by the simulator; real deployments never call this."""
-    global _enabled, _max_acked
-    _enabled = True
-    _max_acked = 0
-    _acked_from.clear()
+class _Disabled:
+    """Real deployments have no global vantage point: every probe no-ops."""
+
+    enabled = False
+
+    def debug_advance_max_committed(self, version, who="?"):
+        pass
+
+    def debug_grv_floor(self) -> int:
+        return 0
+
+    def debug_check_read_version(self, version, floor, who="?"):
+        pass
 
 
-def reset():
-    global _max_acked
-    _max_acked = 0
-    _acked_from.clear()
+DISABLED = _Disabled()
 
 
-def is_enabled() -> bool:
-    return _enabled
-
-
-def debug_advance_max_committed(version: int, who: str = "?"):
-    """Called by a proxy when it ACKS a commit at `version` to a client
-    (debug_advanceMaxCommittedVersion). Each version is acked by exactly one
-    batch on one proxy; a duplicate ack from elsewhere means two batches
-    believed they owned the same master-assigned version."""
-    global _max_acked
-    if not _enabled:
-        return
-    prev = _acked_from.get(version)
-    assert prev is None or prev == who, \
-        f"version {version} acked by both {prev} and {who}"
-    _acked_from[version] = who
-    if version > _max_acked:
-        _max_acked = version
-    # bound memory AND work: over the cap, drop the oldest half by version
-    # (a fixed version-distance window prunes nothing when versions advance
-    # slowly, turning long dense sims quadratic)
-    if len(_acked_from) > 65536:
-        keep = sorted(_acked_from)[len(_acked_from) // 2:]
-        kept = {v: _acked_from[v] for v in keep}
-        _acked_from.clear()
-        _acked_from.update(kept)
-
-
-def debug_grv_floor() -> int:
-    """Snapshot the external-consistency floor when a GRV request ARRIVES:
-    the reply must be >= this (every commit acked before the request)."""
-    return _max_acked if _enabled else 0
-
-
-def debug_check_read_version(version: int, floor: int, who: str = "?"):
-    """Called with the GRV reply and the floor snapshotted at arrival
-    (debug_checkMinCommittedVersion): handing out less would let a client
-    miss a write it was already told succeeded."""
-    if not _enabled:
-        return
-    assert version >= floor, \
-        f"{who} handed out read version {version} < acked floor {floor}"
+def of(net):
+    """The oracle attached to a network (SimNetwork carries one); no-op for
+    real transports."""
+    return getattr(net, "validation", None) or DISABLED

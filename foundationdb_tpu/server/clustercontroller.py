@@ -1085,11 +1085,26 @@ class ClusterController:
         metadata txn, publish, updated serving ranges. The dropped member's
         tag is GC'd by _forget_tags once no team references it."""
         from foundationdb_tpu.server import systemdata
+        from foundationdb_tpu.server.replication import (
+            policy_for_replication, select_replicas)
         teams = [list(t) for t in info.teams()]
         b = list(info.shard_boundaries)
         team = teams[i]
         addr_of_tag = {t: a for a, t in info.storages}
-        new_team = sorted(team)[:want]
+        # retain a subset that still satisfies the replication policy at the
+        # new size (dropping by tag order alone can keep two same-zone
+        # replicas and drop the only one in a distinct zone)
+        policy = policy_for_replication(want)
+        tag_of_addr = {a: t for a, t in info.storages}
+        cands = [(addr_of_tag[t], self.registry.locality_of(addr_of_tag[t]))
+                 for t in sorted(team) if t in addr_of_tag]
+        picked = select_replicas(policy, cands)
+        if picked is not None and len(picked) == want:
+            new_team = sorted(tag_of_addr[a] for a in picked)
+        else:
+            new_team = sorted(team)[:want]
+            TraceEvent("DDShrinkTeamNoPolicySubset", self.process.address) \
+                .detail("Shard", i).detail("Policy", str(policy)).log()
         TraceEvent("DDShrinkTeam", self.process.address) \
             .detail("Shard", i).detail("From", team).detail("To", new_team).log()
         await self._commit_metadata_txn(

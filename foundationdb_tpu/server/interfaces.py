@@ -58,10 +58,18 @@ class Token:
 
 @dataclass
 class GetCommitVersionRequest:
-    """masterserver.actor.cpp:822 getVersion. requestNum dedupes retransmits."""
+    """masterserver.actor.cpp:822 getVersion. requestNum dedupes retransmits.
+
+    epoch fences deposed generations: well-known tokens are re-registered at
+    the same address by each recruitment, so without the fence a zombie
+    proxy could consume versions from the NEW master's chain and push them
+    only to its own LOCKED TLogs — a permanent gap that wedges every
+    later batch of the new generation (the reference avoids this with
+    per-recruitment interface UIDs)."""
 
     proxy_id: int
     request_num: int
+    epoch: int = 0
 
 
 @dataclass

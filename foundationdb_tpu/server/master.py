@@ -119,6 +119,12 @@ class Master:
         if self.deposed:
             reply.send_error(FDBError("master_recovery_failed", "deposed"))
             return
+        if req.epoch != self.epoch:
+            # a proxy from another generation must never consume a version
+            # from THIS chain (it would push it to its own, locked, TLogs)
+            reply.send_error(FDBError("master_recovery_failed",
+                                      f"epoch {req.epoch} != {self.epoch}"))
+            return
         prev = self._last_reply.get(req.proxy_id)
         if prev is not None and prev[0] == req.request_num:
             reply.send(prev[1])  # retransmit: same version again

@@ -129,7 +129,13 @@ class Proxy:
         self.txn_state_version = recovery_version
         self.shards = self._shards_from_txn_state()
         self.backup_ranges = self._backup_ranges_from_txn_state()
-        self._last_batch_version = recovery_version  # own previous batch
+        # newest version through which THIS proxy has applied state-mutation
+        # windows — the last_receive ack sent to resolvers. Resolvers prune
+        # retained state txns by the MIN ack over all proxies, so the ack's
+        # contract is "everything <= V is applied here"; advancing it only
+        # after phase-3 application (never at dispatch) means a failed batch
+        # can never cause a window to be pruned before it was applied.
+        self._last_applied_version = recovery_version
         # The recovery snapshot carries keyServers only; an in-flight
         # BACKUP's tee ranges live durably in the database. A recruited
         # proxy reads them from storage BEFORE accepting any commit (the
@@ -411,11 +417,11 @@ class Proxy:
         self._serve_grv(reply)
 
     def _serve_grv(self, reply):
-        floor = sim_validation.debug_grv_floor()
+        floor = sim_validation.of(self.process.net).debug_grv_floor()
         if not self.other_proxies:
             self.grv_bands.add(0.0)
             v = self.committed_version.get()
-            sim_validation.debug_check_read_version(
+            sim_validation.of(self.process.net).debug_check_read_version(
                 v, floor, self.process.address)
             reply.send(GetReadVersionReply(version=v))
             return
@@ -434,7 +440,7 @@ class Proxy:
             self.grv_bands.add(self.loop.now() - t0)
             # external consistency oracle: >= every commit acked before the
             # GRV arrived (debug_checkMinCommittedVersion)
-            sim_validation.debug_check_read_version(
+            sim_validation.of(self.process.net).debug_check_read_version(
                 version, floor, self.process.address)
             reply.send(GetReadVersionReply(version=version))
         except FDBError as e:
@@ -488,6 +494,7 @@ class Proxy:
         t_ins = [t for _req, _rep, t in batch]
         resolution_started = False
         state_applied = False
+        batch_meta: list[list | None] = []  # per request
         g_trace_batch.add_event("CommitDebug", f"b{self.proxy_id}.{batch_n}",
                                 "Proxy.commitBatch.Before")
         try:
@@ -499,15 +506,17 @@ class Proxy:
             # fetch still ASSIGNED the version on the master, and abandoning
             # it would leave a permanent gap in the resolvers' prevVersion
             # chain that wedges every later batch
-            req = GetCommitVersionRequest(self.proxy_id, self._request_num)
+            req = GetCommitVersionRequest(self.proxy_id, self._request_num,
+                                          self.epoch)
             ver = None
             while ver is None:
                 try:
                     ver = await self.process.net.request(
                         self.process, self.master, req)
                 except FDBError as e:
-                    if e.name == "operation_cancelled":
-                        raise
+                    if e.name in ("operation_cancelled",
+                                  "master_recovery_failed"):
+                        raise  # cancelled, or fenced by a newer generation
                     if not self._master_live():
                         raise  # master gone: recovery will replace us
                     await self.loop.delay(0.2)
@@ -522,7 +531,6 @@ class Proxy:
             # in resolver 0's request (ResolutionRequestBuilder :307-311)
             state_idx: list[list[int]] = [[] for _ in range(n_res)]
             state_muts: list[list[list]] = [[] for _ in range(n_res)]
-            batch_meta: list[list | None] = []  # per request
             for req in requests:
                 meta = [m for m in req.mutations
                         if systemdata.is_metadata_mutation(m)]
@@ -546,8 +554,11 @@ class Proxy:
                         state_muts[r].append(meta if r == 0 else [])
                 txn_resolver_slots.append(slots)
 
-            last_receive = self._last_batch_version
-            self._last_batch_version = commit_version
+            # ack only APPLIED windows (see _last_applied_version): an older
+            # ack just widens the reply window, and already-applied versions
+            # are skipped below — so dispatch needn't wait on the previous
+            # batch's phase 3 and resolution stays pipelined
+            last_receive = self._last_applied_version
             resolve_futures = [
                 self.process.net.request(
                     self.process, self.resolvers.endpoints[r],
@@ -618,6 +629,12 @@ class Proxy:
             for status, meta in zip(statuses, batch_meta):
                 if status == COMMITTED and meta:
                     self._apply_metadata(meta, commit_version)
+            # every state window <= commit_version is now applied here:
+            # phase 3 runs in batch order (latest_logging gate), this reply
+            # covered (last_receive, commit_version), and own metadata just
+            # landed — so future batches may ack through commit_version
+            self._last_applied_version = max(self._last_applied_version,
+                                             commit_version)
 
             messages: dict[int, list[Mutation]] = {}
             batch_order = 0
@@ -664,7 +681,10 @@ class Proxy:
                         uid=uid))
                 for tl, uid in zip(self.tlogs, self.tlog_uids)]
             await self._wait_quorum(log_futures, quorum)
-            self.latest_logging.set(batch_n)
+            # monotonic: a LATER batch that failed early (before its phase-3
+            # gate) already max-set this past batch_n in its except handler;
+            # a plain set would throw and abort this healthy batch
+            self.latest_logging.set(max(self.latest_logging.get(), batch_n))
 
             # ---- Phase 5: replies (:862) ----
             g_trace_batch.add_event(
@@ -690,7 +710,7 @@ class Proxy:
                 # sim-only oracle (debug_advanceMaxCommittedVersion,
                 # MasterProxyServer.actor.cpp:820): acked versions are
                 # unique per batch, and every later GRV must be >= this
-                sim_validation.debug_advance_max_committed(
+                sim_validation.of(self.process.net).debug_advance_max_committed(
                     commit_version, f"{self.process.address}/b{batch_n}")
         except Exception as e:  # noqa: BLE001
             # a failed stage fails the whole batch; clients retry
@@ -698,22 +718,27 @@ class Proxy:
             self.latest_resolving.set(max(self.latest_resolving.get(), batch_n))
             self.latest_logging.set(max(self.latest_logging.get(), batch_n))
             detail = getattr(e, "name", type(e).__name__)
+            # NOTE: _last_applied_version is deliberately NOT advanced for a
+            # failed batch — its state window stays un-acked, the resolvers
+            # retain the entries, and a later batch's (older-ack, wider)
+            # window re-covers them
             for rep in replies:
                 if not rep.is_set():
                     rep.send_error(FDBError("commit_unknown_result", detail))
             if detail != "operation_cancelled":
                 self._infra_failures += 1
-                if resolution_started and not state_applied:
-                    # we never applied this batch's state-mutation window.
-                    # Rewind so the NEXT batch's window re-covers it — the
-                    # resolvers prune by ACKED last_receive_version, so the
-                    # entries are still retained. A recruited proxy whose
-                    # failures persist still dies below and the generation
-                    # is rebuilt (the reference's answer to any resolver
-                    # failure).
-                    self._last_batch_version = min(self._last_batch_version,
-                                                   self.txn_state_version)
-                if self.die_on_failure and self._infra_failures >= 3:
+                state_batch_lost = (resolution_started
+                                    and any(m for m in batch_meta))
+                if self.die_on_failure and (state_batch_lost
+                                            or self._infra_failures >= 3):
+                    # a post-resolution failure of a batch CARRYING state
+                    # transactions is immediately fatal: the resolvers
+                    # recorded committed verdicts other proxies will apply
+                    # to their txnStateStores, but the batch may never be
+                    # durable — only a recovery reconciles that (the
+                    # reference kills the proxy on any commit-pipeline
+                    # error). Plain data batches keep retry slack so a
+                    # transient TLog blip doesn't churn generations.
                     self.die(f"commit pipeline failing: {detail}")
 
     def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:

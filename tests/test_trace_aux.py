@@ -151,7 +151,12 @@ def test_sim_validation_oracles():
 
     KNOBS.set("CONFLICT_BACKEND", "oracle")
     c = SimCluster(seed=6, n_proxies=2, n_resolvers=1, n_tlogs=1, n_storage=1)
-    assert sv.is_enabled()
+    oracle = sv.of(c.net)
+    assert oracle.enabled
+    # a second simulated cluster in the same interpreter gets its OWN oracle
+    # (state is per-SimNetwork, not module-global)
+    c2 = SimCluster(seed=7, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1)
+    assert sv.of(c2.net) is not oracle
 
     async def t():
         for i in range(10):
@@ -161,13 +166,13 @@ def test_sim_validation_oracles():
             await tr.commit()
     db = c.database()
     c.run(c.loop.spawn(t()), max_time=600.0)
-    assert sv.debug_grv_floor() > 0  # acks were observed
+    assert oracle.debug_grv_floor() > 0  # acks were observed
+    assert sv.of(c2.net).debug_grv_floor() == 0  # and c2's saw none of them
 
     # a violating sequence asserts (the oracle has teeth)
-    sv.debug_advance_max_committed(10**15, "pA/b1")
+    oracle.debug_advance_max_committed(10**15, "pA/b1")
     with pytest.raises(AssertionError):
-        sv.debug_advance_max_committed(10**15, "pB/b9")
+        oracle.debug_advance_max_committed(10**15, "pB/b9")
     with pytest.raises(AssertionError):
-        sv.debug_check_read_version(1, 10**15, "pA")
-    sv.reset()
+        oracle.debug_check_read_version(1, 10**15, "pA")
     KNOBS.reset()
