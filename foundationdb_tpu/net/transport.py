@@ -150,13 +150,30 @@ class NetTransport:
         self._peers: dict[str, asyncio.Future] = {}
         self._pending: dict[int, Promise] = {}  # reply_id -> promise
         self._next_reply_id = 1
+        # every asyncio task this transport spawns (reply readers, sends):
+        # close() cancels and drains them so teardown never leaks pending
+        # tasks ("Task was destroyed but it is pending!")
+        self._tasks: set[asyncio.Task] = set()
+        # established incoming connections: the listener's close() only stops
+        # NEW connections, so these must be dropped explicitly or their
+        # _on_connection read loops outlive the transport
+        self._incoming: set[asyncio.StreamWriter] = set()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        t = self.loop.aio.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return t
 
     # -- lifecycle --
 
     async def _aio_start(self):
         host, port = self.address.rsplit(":", 1)
+        # sync callback so the per-connection read loop is OUR tracked task
+        # (start_server's own wrapping would bypass _spawn and leak at close)
         self._server = await asyncio.start_server(
-            self._on_connection, host, int(port))
+            lambda r, w: self._spawn(self._on_connection(r, w)),
+            host, int(port))
 
     def start(self):
         self.loop.aio.run_until_complete(self._aio_start())
@@ -164,6 +181,15 @@ class NetTransport:
     def close(self):
         if self._server is not None:
             self._server.close()
+        for w in list(self._incoming):
+            w.close()
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks and not self.loop.aio.is_running():
+            # let the cancellations actually run (a cancelled-but-unreaped
+            # task still warns at loop GC)
+            self.loop.aio.run_until_complete(
+                asyncio.gather(*self._tasks, return_exceptions=True))
         for fut in self._peers.values():
             if fut.done() and not fut.cancelled() and fut.exception() is None:
                 fut.result().close()
@@ -208,7 +234,7 @@ class NetTransport:
             raise
         w.write(_CONNECT)
         fut.set_result(w)
-        self.loop.aio.create_task(self._read_replies(_r, address))
+        self._spawn(self._read_replies(_r, address))
         return w
 
     def request(self, src, dest, payload, priority: int = 0,
@@ -237,7 +263,7 @@ class NetTransport:
                     entry[0].send_error(FDBError("broken_promise",
                                                  "connect/encode failed"))
 
-        self.loop.aio.create_task(send())
+        self._spawn(send())
         if timeout is not None:
             def expire():
                 entry = self._pending.pop(reply_id, None)
@@ -257,7 +283,7 @@ class NetTransport:
                 pass  # unserializable one-way == dropped packet
             except OSError:
                 self._peers.pop(dest.address, None)
-        self.loop.aio.create_task(send())
+        self._spawn(send())
 
     # -- incoming --
 
@@ -278,6 +304,7 @@ class NetTransport:
 
     async def _on_connection(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
+        self._incoming.add(writer)
         try:
             connect = await reader.readexactly(len(_CONNECT))
             if connect != _CONNECT:
@@ -295,6 +322,8 @@ class NetTransport:
                                                  wire.dumps("unknown_error")))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return
+        finally:
+            self._incoming.discard(writer)
 
     def _dispatch(self, token, reply_id, kind, payload, writer):
         handler = self.process.handlers.get(token)

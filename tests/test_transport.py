@@ -55,6 +55,10 @@ def test_transport_request_reply_loopback():
     finally:
         a.close()
         b.close()
+    # teardown contract: close() cancels and reaps every task the transport
+    # spawned (reply readers, sends) — a leftover pending task would warn
+    # "Task was destroyed but it is pending!" at loop GC
+    assert not a._tasks and not b._tasks
 
 
 def test_multiprocess_cluster_serves_gets_and_commits(tmp_path):
