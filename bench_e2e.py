@@ -132,11 +132,11 @@ def run(clients: int = 100, seconds: float = 4.0) -> dict:
                             else:
                                 reads.append(b"k%06d" % rng.randrange(KEYS))
                         if reads:
-                            # issue a txn's reads concurrently (the
-                            # reference's clients pipeline futures the same
-                            # way; benchmarking.rst's read numbers assume it)
-                            await all_of([loop.spawn(tr.get(k), name="g")
-                                          for k in reads])
+                            # issue a txn's reads concurrently as futures —
+                            # the reference's client API shape
+                            # (fdb_transaction_get -> FDBFuture; its bench
+                            # clients wait on N outstanding futures)
+                            await all_of([tr.get_future(k) for k in reads])
                         if wrote:
                             t1 = time.perf_counter()
                             await tr.commit()

@@ -97,6 +97,12 @@ class Database:
         self._rng = rng or DeterministicRandom(0xDB)
         self._grv_waiters: list[Future] = []
         self._grv_armed = False
+        # read batcher (readVersionBatcher pattern on the data path): every
+        # concurrent point read in this process is coalesced into per-team
+        # GetValuesRequest RPCs — the per-message cost, not the lookup,
+        # dominates a Python host's read path
+        self._read_queue: list[tuple[bytes, int, Future]] = []
+        self._read_armed = False
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)
@@ -257,8 +263,88 @@ class Database:
         raise FDBError("wrong_shard_server", "location cache cannot converge")
 
     def _get_value(self, req: GetValueRequest) -> Future:
-        return self.loop.spawn(self._storage_request(
-            req.key, Token.STORAGE_GET_VALUE, req), "getValue")
+        f = Future()
+        self._read_queue.append((req.key, req.version, f))
+        if len(self._read_queue) >= KNOBS.READ_BATCH_MAX:
+            queue, self._read_queue = self._read_queue, []
+            self.process.spawn(self._send_read_batches(queue), "readBatch")
+        elif not self._read_armed:
+            self._read_armed = True
+            self.process.spawn(self._read_flush(), "readBatcher")
+        return f
+
+    async def _read_flush(self):
+        await self.loop.delay(KNOBS.READ_BATCH_INTERVAL)
+        self._read_armed = False
+        queue, self._read_queue = self._read_queue, []
+        if queue:
+            await self._send_read_batches(queue)
+
+    async def _send_read_batches(self, entries):
+        """Group queued reads by storage team and fan the batches out."""
+        try:
+            await self._ensure_locations()
+        except FDBError as e:
+            for _k, _v, f in entries:
+                if not f.is_ready():
+                    f._set_error(FDBError(e.name, e.detail))
+            return
+        groups: dict[tuple, list] = {}
+        for k, v, f in entries:
+            team, _end = self.locations.locate(k)
+            groups.setdefault(tuple(team), []).append((k, v, f))
+        for team, ents in groups.items():
+            self.process.spawn(self._send_read_group(list(team), ents),
+                               "readBatchGroup")
+
+    def _read_fallback(self, k: bytes, v: int, f: Future):
+        """Single-key path for a read that fell out of a batch: re-resolves
+        the location cache and fails over on its own."""
+        self._chain(f, self.loop.spawn(self._storage_request(
+            k, Token.STORAGE_GET_VALUE,
+            GetValueRequest(key=k, version=v)), "getValue"))
+
+    async def _send_read_group(self, team: list[str], ents):
+        from foundationdb_tpu.server.interfaces import (
+            GetValueReply, GetValuesRequest)
+        req = GetValuesRequest(reads=[(k, v) for k, v, _f in ents])
+        try:
+            rep = await self._on_team(
+                team, lambda addr: self.process.net.request(
+                    self.process, Endpoint(addr, Token.STORAGE_GET_VALUES),
+                    req))
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            # whole-batch failure (team down, future_version, stale shard)
+            if e.name == "wrong_shard_server" and self.coordinators:
+                self.locations.invalidate()
+            for k, v, f in ents:
+                if not f.is_ready():
+                    self._read_fallback(k, v, f)
+            return
+        for (k, v, f), (code, payload) in zip(ents, rep.results):
+            if f.is_ready():
+                continue
+            if code == 0:
+                f._set(GetValueReply(value=payload, version=v))
+            elif payload == "wrong_shard_server" and self.coordinators:
+                # only this key's shard moved: re-resolve it individually
+                self.locations.invalidate()
+                self._read_fallback(k, v, f)
+            else:
+                f._set_error(FDBError(payload))
+
+    @staticmethod
+    def _chain(dst: Future, src: Future):
+        def relay(s):
+            if dst.is_ready():
+                return
+            if s.is_error():
+                dst._set_error(s._result)
+            else:
+                dst._set(s._result)
+        src.add_callback(relay)
 
     def _get_range(self, req: GetKeyValuesRequest) -> Future:
         return self.loop.spawn(self._get_range_shards(req), "getRangeShards")

@@ -65,6 +65,46 @@ class Transaction:
             return point.resolve(base)  # pending atomic ops over storage value
         return base
 
+    def get_future(self, key: bytes, snapshot: bool = False):
+        """Future-shaped point read — the reference's actual client API
+        (fdb_transaction_get returns an FDBFuture; NativeAPI Transaction::get
+        returns Future<Optional<Value>>, NativeAPI.actor.cpp:1869). No actor
+        is spawned per read: the request goes straight into the database's
+        read batcher and the returned Future resolves to the value. This is
+        what lets a client issue a transaction's reads concurrently at
+        reference-like per-op cost; `get` remains the awaitable convenience
+        wrapper."""
+        from foundationdb_tpu.core.future import Future
+        self._check_key(key)
+        has_point, point, cleared = self._writes.lookup(key)
+        out = Future()
+        if has_point and point.known:
+            out._set(point.value)
+            return out
+        if cleared:
+            out._set(None)
+            return out
+        if self._read_version is None:
+            # no read version yet: fall back to the coroutine path (it
+            # fetches one); callers batching reads fetch the GRV first
+            return self.db.loop.spawn(self.get(key, snapshot), "get")
+        inner = self.db._get_value(
+            GetValueRequest(key=key, version=self._read_version))
+        if not snapshot:
+            self._read_conflicts.append((key, key + b"\x00"))
+
+        def relay(f):
+            if out.is_ready():
+                return
+            if f.is_error():
+                out._set_error(f._result)
+            elif has_point:
+                out._set(point.resolve(f._result.value))
+            else:
+                out._set(f._result.value)
+        inner.add_callback(relay)
+        return out
+
     async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (NativeAPI getKey). RYW-merged via a
         range read of plain byte bounds (avoids selector-end exclusivity)."""

@@ -150,11 +150,503 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* ------------------------------------------------------------------ */
+/* Wire codec (utils/wire.py format; flow/serialize.h analogue)        */
+/*                                                                     */
+/* Fast path only: exact builtin types plus REGISTERED enum/dataclass  */
+/* types. Anything else raises, and the Python wrapper re-runs the     */
+/* pure-Python codec, which remains the semantic authority for every   */
+/* edge case (int >64-bit, bytearray, subclasses, schema skew).        */
+/* ------------------------------------------------------------------ */
+
+#define W_MAGIC 0xF5
+#define W_VERSION 1
+#define W_MAX_DEPTH 64
+#define W_MAX_CONTAINER (1 << 24)
+
+/* registry: by_id[int] = (cls, names_tuple_or_None); by_type[type] = id */
+static PyObject *g_by_id = NULL;
+static PyObject *g_by_type = NULL;
+
+static PyObject *py_wire_set_registry(PyObject *self, PyObject *args) {
+    PyObject *by_id, *by_type;
+    if (!PyArg_ParseTuple(args, "OO", &by_id, &by_type))
+        return NULL;
+    Py_XDECREF(g_by_id);
+    Py_XDECREF(g_by_type);
+    g_by_id = Py_NewRef(by_id);
+    g_by_type = Py_NewRef(by_type);
+    Py_RETURN_NONE;
+}
+
+typedef struct {
+    uint8_t *buf;
+    Py_ssize_t len, cap;
+} WBuf;
+
+static int wb_grow(WBuf *w, Py_ssize_t extra) {
+    Py_ssize_t need = w->len + extra;
+    if (need <= w->cap)
+        return 0;
+    Py_ssize_t cap = w->cap * 2;
+    if (cap < need)
+        cap = need + 256;
+    uint8_t *nb = PyMem_Realloc(w->buf, cap);
+    if (!nb) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    w->buf = nb;
+    w->cap = cap;
+    return 0;
+}
+
+static inline int wb_byte(WBuf *w, uint8_t b) {
+    if (w->len >= w->cap && wb_grow(w, 1) < 0)
+        return -1;
+    w->buf[w->len++] = b;
+    return 0;
+}
+
+static inline int wb_raw(WBuf *w, const void *p, Py_ssize_t n) {
+    if (w->len + n > w->cap && wb_grow(w, n) < 0)
+        return -1;
+    memcpy(w->buf + w->len, p, n);
+    w->len += n;
+    return 0;
+}
+
+static inline int wb_varint(WBuf *w, uint64_t v) {
+    while (v > 0x7F) {
+        if (wb_byte(w, (uint8_t)(v & 0x7F) | 0x80) < 0)
+            return -1;
+        v >>= 7;
+    }
+    return wb_byte(w, (uint8_t)v);
+}
+
+static int enc_value(WBuf *w, PyObject *obj, int depth);
+
+static int enc_container_items(WBuf *w, PyObject *seq, int depth) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < n; i++)
+        if (enc_value(w, items[i], depth) < 0)
+            return -1;
+    return 0;
+}
+
+static int enc_value(WBuf *w, PyObject *obj, int depth) {
+    if (depth > W_MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "nesting too deep");
+        return -1;
+    }
+    PyTypeObject *tp = Py_TYPE(obj);
+    if (tp == &PyBytes_Type) {
+        Py_ssize_t n = PyBytes_GET_SIZE(obj);
+        if (wb_byte(w, 'b') < 0 || wb_varint(w, (uint64_t)n) < 0)
+            return -1;
+        return wb_raw(w, PyBytes_AS_STRING(obj), n);
+    }
+    if (tp == &PyLong_Type) {
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+        if (overflow || (v == -1 && PyErr_Occurred())) {
+            PyErr_SetString(PyExc_OverflowError, "int beyond int64");
+            return -1; /* wrapper falls back to the Python codec */
+        }
+        uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+        if (wb_byte(w, 'i') < 0)
+            return -1;
+        return wb_varint(w, u);
+    }
+    if (tp == &PyUnicode_Type) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(obj, &n);
+        if (!s)
+            return -1;
+        if (wb_byte(w, 's') < 0 || wb_varint(w, (uint64_t)n) < 0)
+            return -1;
+        return wb_raw(w, s, n);
+    }
+    if (tp == &PyList_Type) {
+        if (wb_byte(w, 'l') < 0 ||
+            wb_varint(w, (uint64_t)PyList_GET_SIZE(obj)) < 0)
+            return -1;
+        return enc_container_items(w, obj, depth + 1);
+    }
+    if (tp == &PyTuple_Type) {
+        if (wb_byte(w, 't') < 0 ||
+            wb_varint(w, (uint64_t)PyTuple_GET_SIZE(obj)) < 0)
+            return -1;
+        return enc_container_items(w, obj, depth + 1);
+    }
+    if (tp == &PyDict_Type) {
+        if (wb_byte(w, 'm') < 0 ||
+            wb_varint(w, (uint64_t)PyDict_GET_SIZE(obj)) < 0)
+            return -1;
+        Py_ssize_t pos = 0;
+        PyObject *k, *v;
+        while (PyDict_Next(obj, &pos, &k, &v)) {
+            if (enc_value(w, k, depth + 1) < 0 ||
+                enc_value(w, v, depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (obj == Py_None)
+        return wb_byte(w, 'N');
+    if (obj == Py_True)
+        return wb_byte(w, 'T');
+    if (obj == Py_False)
+        return wb_byte(w, 'F');
+    if (tp == &PyFloat_Type) {
+        double d = PyFloat_AS_DOUBLE(obj);
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        uint8_t be[8];
+        for (int i = 0; i < 8; i++)
+            be[i] = (uint8_t)(bits >> (56 - 8 * i));
+        if (wb_byte(w, 'd') < 0)
+            return -1;
+        return wb_raw(w, be, 8);
+    }
+    if (tp == &PySet_Type || tp == &PyFrozenSet_Type) {
+        if (wb_byte(w, 'S') < 0 ||
+            wb_varint(w, (uint64_t)PySet_GET_SIZE(obj)) < 0)
+            return -1;
+        PyObject *it = PyObject_GetIter(obj);
+        if (!it)
+            return -1;
+        PyObject *item;
+        while ((item = PyIter_Next(it)) != NULL) {
+            int rc = enc_value(w, item, depth + 1);
+            Py_DECREF(item);
+            if (rc < 0) {
+                Py_DECREF(it);
+                return -1;
+            }
+        }
+        Py_DECREF(it);
+        return PyErr_Occurred() ? -1 : 0;
+    }
+    /* registered enum / dataclass (exact type match only) */
+    PyObject *idobj =
+        g_by_type ? PyDict_GetItem(g_by_type, (PyObject *)tp) : NULL;
+    if (idobj) {
+        uint64_t tid = (uint64_t)PyLong_AsUnsignedLongLong(idobj);
+        if (PyLong_Check(obj)) { /* IntEnum */
+            long long v = PyLong_AsLongLong(obj);
+            if (v == -1 && PyErr_Occurred())
+                return -1;
+            uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+            if (wb_byte(w, 'E') < 0 || wb_varint(w, tid) < 0)
+                return -1;
+            return wb_varint(w, u);
+        }
+        PyObject *entry = PyDict_GetItem(g_by_id, idobj);
+        if (!entry || !PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) < 2) {
+            PyErr_SetString(PyExc_ValueError, "bad registry entry");
+            return -1;
+        }
+        PyObject *names = PyTuple_GET_ITEM(entry, 1);
+        if (names == Py_None) {
+            PyErr_SetString(PyExc_ValueError, "non-dataclass struct");
+            return -1;
+        }
+        Py_ssize_t nf = PyTuple_GET_SIZE(names);
+        if (wb_byte(w, 'R') < 0 || wb_varint(w, tid) < 0 ||
+            wb_varint(w, (uint64_t)nf) < 0)
+            return -1;
+        for (Py_ssize_t i = 0; i < nf; i++) {
+            PyObject *v = PyObject_GetAttr(obj, PyTuple_GET_ITEM(names, i));
+            if (!v)
+                return -1;
+            int rc = enc_value(w, v, depth + 1);
+            Py_DECREF(v);
+            if (rc < 0)
+                return -1;
+        }
+        return 0;
+    }
+    PyErr_Format(PyExc_OverflowError, "no native fast path for %s",
+                 tp->tp_name); /* wrapper falls back */
+    return -1;
+}
+
+static PyObject *py_wire_dumps(PyObject *self, PyObject *obj) {
+    WBuf w = {NULL, 0, 0};
+    if (wb_grow(&w, 64) < 0)
+        return NULL;
+    w.buf[w.len++] = W_MAGIC;
+    w.buf[w.len++] = W_VERSION;
+    if (enc_value(&w, obj, 0) < 0) {
+        PyMem_Free(w.buf);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
+/* ---------------- decode ---------------- */
+
+typedef struct {
+    const uint8_t *p, *end;
+} RBuf;
+
+static int rb_varint(RBuf *r, uint64_t *out) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (;;) {
+        if (r->p >= r->end) {
+            PyErr_SetString(PyExc_ValueError, "truncated");
+            return -1;
+        }
+        uint8_t b = *r->p++;
+        if (shift > 63 || (shift == 63 && (b & 0x7E))) {
+            /* >64-bit varint: legit via the Python encoder (big ints);
+             * every such frame must fall back to the Python decoder —
+             * shifting past the word would be UB and silent corruption */
+            PyErr_SetString(PyExc_OverflowError, "varint beyond int64");
+            return -1;
+        }
+        v |= ((uint64_t)(b & 0x7F)) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return 0;
+        }
+        shift += 7;
+    }
+}
+
+static PyObject *dec_value(RBuf *r, int depth) {
+    if (depth > W_MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "nesting too deep");
+        return NULL;
+    }
+    if (r->p >= r->end) {
+        PyErr_SetString(PyExc_ValueError, "truncated");
+        return NULL;
+    }
+    uint8_t tag = *r->p++;
+    switch (tag) {
+    case 'i': {
+        uint64_t u;
+        if (rb_varint(r, &u) < 0)
+            return NULL;
+        long long v = (long long)((u >> 1) ^ (~(u & 1) + 1));
+        return PyLong_FromLongLong(v);
+    }
+    case 'b': {
+        uint64_t n;
+        if (rb_varint(r, &n) < 0)
+            return NULL;
+        if ((uint64_t)(r->end - r->p) < n) {
+            PyErr_SetString(PyExc_ValueError, "truncated");
+            return NULL;
+        }
+        PyObject *o = PyBytes_FromStringAndSize((const char *)r->p, n);
+        r->p += n;
+        return o;
+    }
+    case 'N':
+        Py_RETURN_NONE;
+    case 'T':
+        Py_RETURN_TRUE;
+    case 'F':
+        Py_RETURN_FALSE;
+    case 'd': {
+        if (r->end - r->p < 8) {
+            PyErr_SetString(PyExc_ValueError, "truncated");
+            return NULL;
+        }
+        uint64_t bits = 0;
+        for (int i = 0; i < 8; i++)
+            bits = (bits << 8) | r->p[i];
+        r->p += 8;
+        double d;
+        memcpy(&d, &bits, 8);
+        return PyFloat_FromDouble(d);
+    }
+    case 's': {
+        uint64_t n;
+        if (rb_varint(r, &n) < 0)
+            return NULL;
+        if ((uint64_t)(r->end - r->p) < n) {
+            PyErr_SetString(PyExc_ValueError, "truncated");
+            return NULL;
+        }
+        PyObject *o = PyUnicode_DecodeUTF8((const char *)r->p, n, NULL);
+        r->p += n;
+        return o;
+    }
+    case 'l':
+    case 't':
+    case 'S': {
+        uint64_t n;
+        if (rb_varint(r, &n) < 0)
+            return NULL;
+        if (n > W_MAX_CONTAINER) {
+            PyErr_SetString(PyExc_ValueError, "container too large");
+            return NULL;
+        }
+        PyObject *lst = (tag == 't') ? PyTuple_New(n) : PyList_New(n);
+        if (!lst)
+            return NULL;
+        for (uint64_t i = 0; i < n; i++) {
+            PyObject *v = dec_value(r, depth + 1);
+            if (!v) {
+                Py_DECREF(lst);
+                return NULL;
+            }
+            if (tag == 't')
+                PyTuple_SET_ITEM(lst, i, v);
+            else
+                PyList_SET_ITEM(lst, i, v);
+        }
+        if (tag == 'S') {
+            PyObject *s = PySet_New(lst);
+            Py_DECREF(lst);
+            return s; /* TypeError (unhashable) -> wrapper fallback */
+        }
+        return lst;
+    }
+    case 'm': {
+        uint64_t n;
+        if (rb_varint(r, &n) < 0)
+            return NULL;
+        if (n > W_MAX_CONTAINER) {
+            PyErr_SetString(PyExc_ValueError, "container too large");
+            return NULL;
+        }
+        PyObject *d = PyDict_New();
+        if (!d)
+            return NULL;
+        for (uint64_t i = 0; i < n; i++) {
+            PyObject *k = dec_value(r, depth + 1);
+            if (!k) {
+                Py_DECREF(d);
+                return NULL;
+            }
+            PyObject *v = dec_value(r, depth + 1);
+            if (!v) {
+                Py_DECREF(k);
+                Py_DECREF(d);
+                return NULL;
+            }
+            int rc = PyDict_SetItem(d, k, v);
+            Py_DECREF(k);
+            Py_DECREF(v);
+            if (rc < 0) {
+                Py_DECREF(d);
+                return NULL;
+            }
+        }
+        return d;
+    }
+    case 'E': {
+        uint64_t tid, u;
+        if (rb_varint(r, &tid) < 0 || rb_varint(r, &u) < 0)
+            return NULL;
+        long long v = (long long)((u >> 1) ^ (~(u & 1) + 1));
+        PyObject *idobj = PyLong_FromUnsignedLongLong(tid);
+        if (!idobj)
+            return NULL;
+        PyObject *entry = g_by_id ? PyDict_GetItem(g_by_id, idobj) : NULL;
+        Py_DECREF(idobj);
+        if (!entry) {
+            PyErr_SetString(PyExc_ValueError, "unknown enum id");
+            return NULL;
+        }
+        PyObject *cls = PyTuple_GET_ITEM(entry, 0);
+        PyObject *vobj = PyLong_FromLongLong(v);
+        if (!vobj)
+            return NULL;
+        PyObject *out = PyObject_CallOneArg(cls, vobj);
+        Py_DECREF(vobj);
+        return out; /* ValueError (bad member) -> wrapper fallback keeps
+                       canonical WireError */
+    }
+    case 'R': {
+        uint64_t tid, n;
+        if (rb_varint(r, &tid) < 0 || rb_varint(r, &n) < 0)
+            return NULL;
+        if (n > 256) {
+            PyErr_SetString(PyExc_ValueError, "struct too wide");
+            return NULL;
+        }
+        PyObject *idobj = PyLong_FromUnsignedLongLong(tid);
+        if (!idobj)
+            return NULL;
+        PyObject *entry = g_by_id ? PyDict_GetItem(g_by_id, idobj) : NULL;
+        Py_DECREF(idobj);
+        if (!entry) {
+            PyErr_SetString(PyExc_ValueError, "unknown struct id");
+            return NULL;
+        }
+        PyObject *cls = PyTuple_GET_ITEM(entry, 0);
+        PyObject *names = PyTuple_GET_ITEM(entry, 1);
+        if (names == Py_None ||
+            (Py_ssize_t)n != PyTuple_GET_SIZE(names)) {
+            /* schema skew (old/new peer): Python decoder handles defaults */
+            PyErr_SetString(PyExc_OverflowError, "schema skew");
+            return NULL;
+        }
+        PyObject *args = PyTuple_New(n);
+        if (!args)
+            return NULL;
+        for (uint64_t i = 0; i < n; i++) {
+            PyObject *v = dec_value(r, depth + 1);
+            if (!v) {
+                Py_DECREF(args);
+                return NULL;
+            }
+            PyTuple_SET_ITEM(args, i, v);
+        }
+        PyObject *out = PyObject_CallObject(cls, args);
+        Py_DECREF(args);
+        return out;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "unknown tag %#x", tag);
+        return NULL;
+    }
+}
+
+static PyObject *py_wire_loads(PyObject *self, PyObject *arg) {
+    Py_buffer data;
+    if (PyObject_GetBuffer(arg, &data, PyBUF_SIMPLE) < 0)
+        return NULL;
+    RBuf r = {(const uint8_t *)data.buf,
+              (const uint8_t *)data.buf + data.len};
+    if (data.len < 2 || r.p[0] != W_MAGIC || r.p[1] > W_VERSION) {
+        PyBuffer_Release(&data);
+        PyErr_SetString(PyExc_ValueError, "bad magic/version");
+        return NULL;
+    }
+    r.p += 2;
+    PyObject *out = dec_value(&r, 0);
+    if (out && r.p != r.end) {
+        Py_DECREF(out);
+        out = NULL;
+        PyErr_SetString(PyExc_ValueError, "trailing bytes");
+    }
+    PyBuffer_Release(&data);
+    return out;
+}
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
     {"encode_keys_into", py_encode_keys_into, METH_VARARGS,
      "encode_keys_into(keys, out_u32_buffer, round_up=False, key_bytes=24)\nkey_bytes MUST match the buffer layout: out has key_bytes/4+1 limb rows."},
+    {"wire_set_registry", py_wire_set_registry, METH_VARARGS,
+     "wire_set_registry(by_id, by_type): install the typed-codec registry"},
+    {"wire_dumps", py_wire_dumps, METH_O,
+     "wire_dumps(obj) -> bytes (raises OverflowError when the pure-Python "
+     "codec must handle the value)"},
+    {"wire_loads", py_wire_loads, METH_O, "wire_loads(bytes) -> obj"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {

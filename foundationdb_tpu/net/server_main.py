@@ -87,9 +87,22 @@ def main(spec_json: str):
         roles.append(role)
     print(f"ready {spec['listen']} roles={[r['role'] for r in spec['roles']]}",
           flush=True)
+    import os
+    prof_path = os.environ.get("FDBTPU_PROFILE")
+    if prof_path:
+        import cProfile
+        import signal
+        pr = cProfile.Profile()
+        pr.enable()
+        # SIGTERM must unwind through finally so the profile is written
+        signal.signal(signal.SIGTERM,
+                      lambda *_a: loop.aio.call_soon_threadsafe(loop.aio.stop))
     try:
         loop.aio.run_forever()
     finally:
+        if prof_path:
+            pr.disable()
+            pr.dump_stats(f"{prof_path}.{spec['listen'].replace(':', '_')}")
         net.close()
         del roles
 

@@ -58,7 +58,14 @@ class RealEventLoop(EventLoop):
         return time.monotonic()
 
     def _schedule(self, delay: float, priority: int, fn):
-        self.aio.call_later(max(0.0, delay), fn)
+        if delay <= 0.0:
+            # the hot path: every actor step reschedules at delay 0.
+            # call_soon is a ready-queue append (FIFO, preserving the
+            # schedule-order contract); call_later(0) would build a
+            # TimerHandle and churn the timer heap per step
+            self.aio.call_soon(fn)
+        else:
+            self.aio.call_later(delay, fn)
 
     def run_future(self, fut: Future, max_time: float | None = None):
         from foundationdb_tpu.core.eventloop import ActorTask
@@ -242,6 +249,14 @@ class NetTransport:
         """Endpoint request with a network-traversing reply promise
         (fdbrpc.h:99 ReplyPromise)."""
         from foundationdb_tpu.utils.knobs import KNOBS
+        if dest.address == self.address:
+            # local endpoint: direct in-memory delivery, no serialization —
+            # the reference's RequestStream::send does exactly this for
+            # non-remote endpoints (fdbrpc/fdbrpc.h: send delivers into the
+            # local queue; only remote endpoints hit FlowTransport). Roles
+            # co-hosted in one process (proxy+master+resolver+tlog) pay no
+            # codec on the commit pipeline's internal hops.
+            return self._local_request(dest, payload, timeout)
         reply = Promise()
         if timeout == -1.0:
             timeout = KNOBS.SIM_RPC_TIMEOUT_SECONDS
@@ -272,7 +287,65 @@ class NetTransport:
             self.loop.aio.call_later(timeout, expire)
         return reply.future
 
+    def _local_request(self, dest, payload, timeout) -> Future:
+        from foundationdb_tpu.utils.knobs import KNOBS
+        reply = Promise()
+        if timeout == -1.0:
+            timeout = KNOBS.SIM_RPC_TIMEOUT_SECONDS
+        handle = None
+        if timeout is not None:
+            # cancel on completion: this is the hottest path in a co-hosted
+            # pipeline, and an uncancelled 5s TimerHandle per request would
+            # retain payloads and churn the timer heap
+            handle = self.loop.aio.call_later(
+                timeout,
+                lambda: reply.send_error(FDBError("request_maybe_delivered"))
+                if not reply.is_set() else None)
+
+        def finish(err=None, value=None):
+            if handle is not None:
+                handle.cancel()
+            if reply.is_set():
+                return
+            if err is not None:
+                reply.send_error(err)
+            else:
+                reply.send(value)
+
+        def deliver():
+            handler = self.process.handlers.get(dest.token)
+            if handler is None:
+                finish(err=FDBError("broken_promise"))
+                return
+            inner = Promise()
+
+            def on_reply(f: Future):
+                if f.is_error():
+                    finish(err=f._result)
+                else:
+                    finish(value=f._result)
+            inner.future.add_callback(on_reply)
+            try:
+                handler(payload, inner)
+            except Exception:  # noqa: BLE001 — parity with remote dispatch:
+                # a raising handler must answer, not strand the caller
+                finish(err=FDBError("unknown_error"))
+
+        self.loop._schedule(0.0, 0, deliver)  # keep the async boundary
+        return reply.future
+
     def one_way(self, src, dest, payload):
+        if dest.address == self.address:
+            def deliver():
+                handler = self.process.handlers.get(dest.token)
+                if handler is not None:
+                    try:
+                        handler(payload, Promise())
+                    except Exception:  # noqa: BLE001 — one-way = dropped
+                        pass
+            self.loop._schedule(0.0, 0, deliver)
+            return
+
         async def send():
             try:
                 body = wire.dumps(payload)

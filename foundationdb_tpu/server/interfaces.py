@@ -35,6 +35,7 @@ class Token:
     TLOG_POP = 32
     STORAGE_GET_VALUE = 40
     STORAGE_GET_KEY_VALUES = 41
+    STORAGE_GET_VALUES = 48  # batched point reads
     STORAGE_WATCH_VALUE = 42
     STORAGE_GET_SHARD_STATE = 43
     TLOG_LOCK = 33
@@ -203,6 +204,26 @@ class GetValueRequest:
 class GetValueReply:
     value: bytes | None
     version: int
+
+
+@dataclass
+class GetValuesRequest:
+    """Batched point reads: the client-side read batcher coalesces every
+    concurrent `get` bound for one storage team into a single RPC (the
+    readVersionBatcher pattern of NativeAPI.actor.cpp:2709 applied to the
+    data path — amortizing per-message cost is what lets a Python host
+    approach the reference's per-core read rates)."""
+
+    reads: list  # [(key, version), ...]
+
+
+@dataclass
+class GetValuesReply:
+    """Parallel to request.reads: (0, value-or-None) | (1, error name).
+    Per-key errors (wrong_shard_server on a moved key, transaction_too_old)
+    must not fail the whole batch."""
+
+    results: list
 
 
 @dataclass

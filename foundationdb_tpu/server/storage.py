@@ -120,6 +120,7 @@ class StorageServer:
         self._pending_durable: deque[tuple[int, list]] = deque()
         self._watches: list[tuple[WatchValueRequest, object]] = []
         process.register(Token.STORAGE_GET_VALUE, self._on_get_value)
+        process.register(Token.STORAGE_GET_VALUES, self._on_get_values)
         process.register(Token.STORAGE_GET_KEY_VALUES, self._on_get_key_values)
         process.register(Token.STORAGE_WATCH_VALUE, self._on_watch)
         process.register(Token.STORAGE_SET_LOGSYSTEM, self._on_set_logsystem)
@@ -486,6 +487,29 @@ class StorageServer:
                                      version=req.version))
         except FDBError as e:
             reply.send_error(e)
+
+    def _on_get_values(self, req, reply):
+        self.process.spawn(self._get_values(req, reply), "getValues")
+
+    async def _get_values(self, req, reply):
+        """Batched point reads (STORAGE_GET_VALUES): one version wait for
+        the whole batch, per-key MVCC lookups, per-key errors in the reply
+        so one moved key doesn't fail its neighbors."""
+        from foundationdb_tpu.server.interfaces import GetValuesReply
+        try:
+            await self._wait_for_version(max(v for _k, v in req.reads))
+        except FDBError as e:
+            reply.send_error(e)  # retryable as a unit (future_version etc.)
+            return
+        out = []
+        for k, v in req.reads:
+            if not self._owns_key(k):
+                out.append((1, "wrong_shard_server"))
+            elif v < self.data.oldest_version:
+                out.append((1, "transaction_too_old"))
+            else:
+                out.append((0, self.data.get(k, v)))
+        reply.send(GetValuesReply(results=out))
 
     # selector resolution (storageserver.actor.cpp findKey)
     def _resolve_selector(self, sel: KeySelector, version: int) -> bytes:

@@ -92,6 +92,8 @@ KNOBS.init("CONFLICT_BATCH_WRITES_PER_TXN", 4)
 # --- Client (fdbclient/Knobs.cpp) ---
 KNOBS.init("MAX_BATCH_SIZE", 20, (1,))  # read-version batcher
 KNOBS.init("GRV_BATCH_INTERVAL", 0.0005, (0.01,))
+KNOBS.init("READ_BATCH_INTERVAL", 0.0005, (0.01,))  # point-read batcher
+KNOBS.init("READ_BATCH_MAX", 250, (2,))  # smaller batches pipeline better
 KNOBS.init("DEFAULT_BACKOFF", 0.01, (1.0,))
 KNOBS.init("MAX_BACKOFF", 1.0)
 KNOBS.init("KEY_SIZE_LIMIT", 10_000)

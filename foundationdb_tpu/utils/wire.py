@@ -20,6 +20,11 @@ Value = tag byte + payload:
     reference.
 
 Struct/enum ids are pinned in _REGISTRY below (never renumber — append).
+
+The encoder/decoder are exact-type-dispatched and cursor-local: this codec
+is the single largest CPU consumer on every process of a running cluster
+(client batchers, proxy pipeline, TLog frames), so the hot paths avoid
+attribute lookups, method calls, and per-byte function calls.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from __future__ import annotations
 import struct
 from dataclasses import MISSING, fields, is_dataclass
 from enum import IntEnum
+from operator import attrgetter
 
 MAGIC = 0xF5
 WIRE_VERSION = 1
@@ -47,7 +53,9 @@ class WireError(Exception):
 _BY_ID: dict[int, type] = {}
 _BY_TYPE: dict[type, int] = {}
 _FIELDS: dict[int, tuple] = {}  # id -> dataclass fields tuple
+_GETTERS: dict[int, object] = {}  # id -> attrgetter over field names
 _loaded = False
+_native = None  # the C codec (native/fdb_native.c), when buildable
 
 
 def _ensure_registry():
@@ -55,6 +63,29 @@ def _ensure_registry():
     if not _loaded:
         _loaded = True
         _register_all()
+        _install_native()
+
+
+def _install_native():
+    """Route the hot path through the C codec. The Python codec stays the
+    semantic authority: any native error falls back to it (int >64-bit,
+    subclasses, schema skew, hostile bytes -> canonical WireError)."""
+    global _native
+    try:
+        from foundationdb_tpu import native
+    except Exception:  # noqa: BLE001 — no compiler is a supported config
+        return
+    if not native.available() or not hasattr(native.mod, "wire_dumps"):
+        return
+    by_id = {}
+    by_type = {}
+    for tid, cls in _BY_ID.items():
+        names = (tuple(f.name for f in _FIELDS[tid])
+                 if tid in _FIELDS else None)
+        by_id[tid] = (cls, names)
+        by_type[cls] = tid
+    native.mod.wire_set_registry(by_id, by_type)
+    _native = native.mod
 
 
 def register(type_id: int, cls: type):
@@ -64,7 +95,14 @@ def register(type_id: int, cls: type):
     _BY_ID[type_id] = cls
     _BY_TYPE[cls] = type_id
     if is_dataclass(cls):
-        _FIELDS[type_id] = fields(cls)
+        fs = fields(cls)
+        _FIELDS[type_id] = fs
+        names = [f.name for f in fs]
+        if len(names) == 1:
+            g = attrgetter(names[0])
+            _GETTERS[type_id] = lambda o, _g=g: (_g(o),)
+        else:
+            _GETTERS[type_id] = attrgetter(*names)
     return cls
 
 
@@ -95,203 +133,312 @@ def _w_zigzag(out: bytearray, v: int):
     _w_varint(out, (v << 1) if v >= 0 else (-v << 1) - 1)
 
 
-class _Reader:
-    __slots__ = ("data", "pos", "end")
-
-    def __init__(self, data: bytes, pos: int = 0):
-        self.data = data
-        self.pos = pos
-        self.end = len(data)
-
-    def take(self, n: int) -> bytes:
-        if n < 0 or self.pos + n > self.end:
-            raise WireError("truncated")
-        b = self.data[self.pos:self.pos + n]
-        self.pos += n
-        return b
-
-    def byte(self) -> int:
-        if self.pos >= self.end:
-            raise WireError("truncated")
-        b = self.data[self.pos]
-        self.pos += 1
-        return b
-
-    def varint(self) -> int:
-        shift = 0
-        v = 0
-        while True:
-            if shift > 1100:  # ~1024-bit bound: big ints round-trip, frames
-                raise WireError("varint overflow")  # can't allocate unbounded
-            b = self.byte()
-            v |= (b & 0x7F) << shift
-            if not b & 0x80:
-                return v
-            shift += 7
-
-    def zigzag(self) -> int:
-        v = self.varint()
-        return (v >> 1) if not v & 1 else -((v + 1) >> 1)
+# tag bytes (precomputed: ord() per tag was measurably hot)
+_T_NONE, _T_TRUE, _T_FALSE = ord("N"), ord("T"), ord("F")
+_T_INT, _T_FLOAT, _T_BYTES, _T_STR = ord("i"), ord("d"), ord("b"), ord("s")
+_T_LIST, _T_TUPLE, _T_DICT, _T_SET = ord("l"), ord("t"), ord("m"), ord("S")
+_T_ENUM, _T_STRUCT = ord("E"), ord("R")
 
 
 # ---------------------------------------------------------------------------
-# values
+# encode
 # ---------------------------------------------------------------------------
+
+def _enc_int(out: bytearray, v: int):
+    # inline zigzag-varint; ints < 2^6 (the common case: tags, flags, small
+    # counters) take the single-append path
+    u = (v << 1) if v >= 0 else ((-v << 1) - 1)
+    out.append(_T_INT)
+    while u > 0x7F:
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+    out.append(u)
+
+
+def _enc_bytes(out: bytearray, v: bytes):
+    out.append(_T_BYTES)
+    n = len(v)
+    while n > 0x7F:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    out += v
+
+
+def _enc_str(out: bytearray, v: str):
+    out.append(_T_STR)
+    b = v.encode("utf-8")
+    _w_varint(out, len(b))
+    out += b
+
+
+def _enc_float(out: bytearray, v: float):
+    out.append(_T_FLOAT)
+    out += _F64.pack(v)
+
+
+def _enc_none(out: bytearray, _v):
+    out.append(_T_NONE)
+
+
+def _enc_bool(out: bytearray, v: bool):
+    out.append(_T_TRUE if v else _T_FALSE)
+
+
+def _enc_list(out: bytearray, v: list):
+    out.append(_T_LIST)
+    _w_varint(out, len(v))
+    enc = _encode_value
+    for x in v:
+        enc(out, x)
+
+
+def _enc_tuple(out: bytearray, v: tuple):
+    out.append(_T_TUPLE)
+    _w_varint(out, len(v))
+    enc = _encode_value
+    for x in v:
+        enc(out, x)
+
+
+def _enc_dict(out: bytearray, v: dict):
+    out.append(_T_DICT)
+    _w_varint(out, len(v))
+    enc = _encode_value
+    for k, x in v.items():
+        enc(out, k)
+        enc(out, x)
+
+
+def _enc_set(out: bytearray, v):
+    out.append(_T_SET)
+    _w_varint(out, len(v))
+    enc = _encode_value
+    for x in v:
+        enc(out, x)
+
+
+_ENC_EXACT = {
+    bytes: _enc_bytes,
+    int: _enc_int,
+    str: _enc_str,
+    list: _enc_list,
+    tuple: _enc_tuple,
+    dict: _enc_dict,
+    float: _enc_float,
+    bool: _enc_bool,
+    type(None): _enc_none,
+    set: _enc_set,
+    frozenset: _enc_set,
+}
+
 
 def _encode_value(out: bytearray, obj):
-    if obj is None:
-        out.append(ord("N"))
-    elif obj is True:
-        out.append(ord("T"))
-    elif obj is False:
-        out.append(ord("F"))
-    elif isinstance(obj, IntEnum):
-        out.append(ord("E"))
-        _w_varint(out, _registered_id(type(obj)))
-        _w_zigzag(out, int(obj))
-    elif isinstance(obj, int):
-        out.append(ord("i"))
-        _w_zigzag(out, obj)
-    elif isinstance(obj, float):
-        out.append(ord("d"))
-        out += _F64.pack(obj)
-    elif isinstance(obj, (bytes, bytearray, memoryview)):
-        out.append(ord("b"))
-        b = bytes(obj)
-        _w_varint(out, len(b))
-        out += b
-    elif isinstance(obj, str):
-        out.append(ord("s"))
-        b = obj.encode("utf-8")
-        _w_varint(out, len(b))
-        out += b
-    elif isinstance(obj, list):
-        out.append(ord("l"))
-        _w_varint(out, len(obj))
-        for x in obj:
-            _encode_value(out, x)
-    elif isinstance(obj, tuple):
-        out.append(ord("t"))
-        _w_varint(out, len(obj))
-        for x in obj:
-            _encode_value(out, x)
-    elif isinstance(obj, dict):
-        out.append(ord("m"))
-        _w_varint(out, len(obj))
-        for k, v in obj.items():
-            _encode_value(out, k)
-            _encode_value(out, v)
-    elif isinstance(obj, (set, frozenset)):
-        out.append(ord("S"))
-        _w_varint(out, len(obj))
-        for x in obj:
-            _encode_value(out, x)
-    elif is_dataclass(obj):
-        tid = _registered_id(type(obj))
-        out.append(ord("R"))
+    f = _ENC_EXACT.get(type(obj))
+    if f is not None:
+        f(out, obj)
+        return
+    _encode_other(out, obj)
+
+
+def _encode_other(out: bytearray, obj):
+    """Subclass / registered-type cases, off the exact-type fast path."""
+    tid = _BY_TYPE.get(type(obj))
+    if tid is not None:
+        if isinstance(obj, IntEnum):
+            out.append(_T_ENUM)
+            _w_varint(out, tid)
+            _w_zigzag(out, int(obj))
+            return
+        out.append(_T_STRUCT)
         _w_varint(out, tid)
-        fs = _FIELDS[tid]
-        _w_varint(out, len(fs))
-        for f in fs:
-            _encode_value(out, getattr(obj, f.name))
-    else:
-        # last resort: anything indexable as an int (numpy scalars from
-        # device fetches routinely leak into versions/counters)
-        try:
-            out.append(ord("i"))
-            _w_zigzag(out, obj.__index__())
-        except AttributeError:
-            raise WireError(f"unserializable type {type(obj).__name__}") from None
+        vals = _GETTERS[tid](obj)
+        _w_varint(out, len(vals))
+        enc = _encode_value
+        for v in vals:
+            enc(out, v)
+        return
+    if isinstance(obj, IntEnum):
+        raise WireError(f"type {type(obj).__name__} is not wire-registered")
+    if isinstance(obj, (bytearray, memoryview)):
+        _enc_bytes(out, bytes(obj))
+        return
+    if isinstance(obj, bool):  # bool subclasses
+        _enc_bool(out, obj)
+        return
+    if isinstance(obj, int):  # int subclasses
+        _enc_int(out, int(obj))
+        return
+    if isinstance(obj, float):
+        _enc_float(out, float(obj))
+        return
+    if isinstance(obj, str):
+        _enc_str(out, str(obj))
+        return
+    if isinstance(obj, list):
+        _enc_list(out, obj)
+        return
+    if isinstance(obj, tuple):
+        _enc_tuple(out, obj)
+        return
+    if isinstance(obj, dict):
+        _enc_dict(out, obj)
+        return
+    if isinstance(obj, (set, frozenset)):
+        _enc_set(out, obj)
+        return
+    if is_dataclass(obj):
+        raise WireError(f"type {type(obj).__name__} is not wire-registered")
+    # last resort: anything indexable as an int (numpy scalars from
+    # device fetches routinely leak into versions/counters)
+    try:
+        _enc_int(out, obj.__index__())
+    except AttributeError:
+        raise WireError(f"unserializable type {type(obj).__name__}") from None
 
 
 _MAX_CONTAINER = 1 << 24  # sanity bound: one frame never has 16M+ elements
 _MAX_DEPTH = 64  # hostile nesting must raise WireError, not RecursionError
 
 
-def _decode_value(r: _Reader, depth: int = 0):
+# ---------------------------------------------------------------------------
+# decode — cursor-local: (data, pos) in, (value, pos) out; no per-byte calls
+# ---------------------------------------------------------------------------
+
+def _r_varint(data: bytes, pos: int, end: int) -> tuple[int, int]:
+    shift = 0
+    v = 0
+    while True:
+        if pos >= end:
+            raise WireError("truncated")
+        if shift > 1100:  # ~1024-bit bound: big ints round-trip, frames
+            raise WireError("varint overflow")  # can't allocate unbounded
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+def _decode_value(data: bytes, pos: int, end: int,
+                  depth: int = 0) -> tuple[object, int]:
     if depth > _MAX_DEPTH:
         raise WireError("nesting too deep")
-    tag = r.byte()
-    if tag == ord("N"):
-        return None
-    if tag == ord("T"):
-        return True
-    if tag == ord("F"):
-        return False
-    if tag == ord("i"):
-        return r.zigzag()
-    if tag == ord("d"):
-        return _F64.unpack(r.take(8))[0]
-    if tag == ord("b"):
-        return r.take(r.varint())
-    if tag == ord("s"):
-        try:
-            return r.take(r.varint()).decode("utf-8")
-        except UnicodeDecodeError as e:
-            raise WireError("bad utf-8") from e
-    if tag in (ord("l"), ord("t"), ord("S")):
-        n = r.varint()
+    if pos >= end:
+        raise WireError("truncated")
+    tag = data[pos]
+    pos += 1
+    if tag == _T_INT:
+        v, pos = _r_varint(data, pos, end)
+        return ((v >> 1) if not v & 1 else -((v + 1) >> 1)), pos
+    if tag == _T_BYTES:
+        n, pos = _r_varint(data, pos, end)
+        if pos + n > end:
+            raise WireError("truncated")
+        return data[pos:pos + n], pos + n
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_LIST or tag == _T_TUPLE or tag == _T_SET:
+        n, pos = _r_varint(data, pos, end)
         if n > _MAX_CONTAINER:
             raise WireError("container too large")
-        items = [_decode_value(r, depth + 1) for _ in range(n)]
-        if tag == ord("t"):
-            return tuple(items)
-        if tag == ord("S"):
+        items = []
+        dec = _decode_value
+        for _ in range(n):
+            v, pos = dec(data, pos, end, depth + 1)
+            items.append(v)
+        if tag == _T_TUPLE:
+            return tuple(items), pos
+        if tag == _T_SET:
             try:
-                return set(items)
+                return set(items), pos
             except TypeError as e:
                 raise WireError("unhashable set element") from e
-        return items
-    if tag == ord("m"):
-        n = r.varint()
+        return items, pos
+    if tag == _T_STRUCT:
+        tid, pos = _r_varint(data, pos, end)
+        cls = _BY_ID.get(tid)
+        fs = _FIELDS.get(tid)
+        if cls is None or fs is None:
+            raise WireError(f"unknown struct id {tid}")
+        n, pos = _r_varint(data, pos, end)
+        if n > 256:
+            raise WireError("struct too wide")
+        vals = []
+        dec = _decode_value
+        for _ in range(n):
+            v, pos = dec(data, pos, end, depth + 1)
+            vals.append(v)
+        if n != len(fs):
+            vals = vals[:len(fs)]  # older schema sent extras we dropped
+            for f in fs[len(vals):]:  # newer schema: fill from defaults
+                if f.default is not MISSING:
+                    vals.append(f.default)
+                elif f.default_factory is not MISSING:
+                    vals.append(f.default_factory())
+                else:
+                    raise WireError(
+                        f"missing required field {cls.__name__}.{f.name}")
+        try:
+            return cls(*vals), pos
+        except TypeError as e:
+            raise WireError(f"bad struct {cls.__name__}") from e
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_FLOAT:
+        if pos + 8 > end:
+            raise WireError("truncated")
+        return _F64.unpack_from(data, pos)[0], pos + 8
+    if tag == _T_STR:
+        n, pos = _r_varint(data, pos, end)
+        if pos + n > end:
+            raise WireError("truncated")
+        try:
+            return data[pos:pos + n].decode("utf-8"), pos + n
+        except UnicodeDecodeError as e:
+            raise WireError("bad utf-8") from e
+    if tag == _T_DICT:
+        n, pos = _r_varint(data, pos, end)
         if n > _MAX_CONTAINER:
             raise WireError("container too large")
         out = {}
+        dec = _decode_value
         for _ in range(n):
-            k = _decode_value(r, depth + 1)
-            v = _decode_value(r, depth + 1)
+            k, pos = dec(data, pos, end, depth + 1)
+            v, pos = dec(data, pos, end, depth + 1)
             try:
                 out[k] = v
             except TypeError as e:
                 raise WireError("unhashable dict key") from e
-        return out
-    if tag == ord("E"):
-        tid = r.varint()
+        return out, pos
+    if tag == _T_ENUM:
+        tid, pos = _r_varint(data, pos, end)
         cls = _BY_ID.get(tid)
-        v = r.zigzag()
+        u, pos = _r_varint(data, pos, end)
+        v = (u >> 1) if not u & 1 else -((u + 1) >> 1)
         if cls is None or not issubclass(cls, IntEnum):
             raise WireError(f"unknown enum id {tid}")
         try:
-            return cls(v)
+            return cls(v), pos
         except ValueError as e:
             raise WireError(f"bad enum value {v}") from e
-    if tag == ord("R"):
-        tid = r.varint()
-        cls = _BY_ID.get(tid)
-        if cls is None or tid not in _FIELDS:
-            raise WireError(f"unknown struct id {tid}")
-        n = r.varint()
-        if n > 256:
-            raise WireError("struct too wide")
-        vals = [_decode_value(r, depth + 1) for _ in range(n)]
-        fs = _FIELDS[tid]
-        vals = vals[:len(fs)]  # older schema sent extras we no longer have
-        for f in fs[len(vals):]:  # newer schema: fill from defaults
-            if f.default is not MISSING:
-                vals.append(f.default)
-            elif f.default_factory is not MISSING:
-                vals.append(f.default_factory())
-            else:
-                raise WireError(f"missing required field {cls.__name__}.{f.name}")
-        try:
-            return cls(*vals)
-        except TypeError as e:
-            raise WireError(f"bad struct {cls.__name__}") from e
     raise WireError(f"unknown tag {tag:#x}")
 
 
 def dumps(obj) -> bytes:
     _ensure_registry()
+    if _native is not None:
+        try:
+            return _native.wire_dumps(obj)
+        except Exception:  # noqa: BLE001 — fall back to the canonical codec
+            pass
+    return _py_dumps(obj)
+
+
+def _py_dumps(obj) -> bytes:
     out = bytearray([MAGIC, WIRE_VERSION])
     _encode_value(out, obj)
     return bytes(out)
@@ -299,14 +446,25 @@ def dumps(obj) -> bytes:
 
 def loads(data: bytes):
     _ensure_registry()
-    r = _Reader(data)
-    if r.byte() != MAGIC:
+    if _native is not None:
+        try:
+            return _native.wire_loads(data)
+        except Exception:  # noqa: BLE001 — fall back for canonical errors
+            pass
+    return _py_loads(data)
+
+
+def _py_loads(data):
+    data = bytes(data)
+    end = len(data)
+    if end < 2:
+        raise WireError("truncated")
+    if data[0] != MAGIC:
         raise WireError("bad magic")
-    v = r.byte()
-    if v > WIRE_VERSION:
-        raise WireError(f"wire version {v} from the future")
-    obj = _decode_value(r)
-    if r.pos != r.end:
+    if data[1] > WIRE_VERSION:
+        raise WireError(f"wire version {data[1]} from the future")
+    obj, pos = _decode_value(data, 2, end)
+    if pos != end:
         raise WireError("trailing bytes")
     return obj
 
@@ -351,5 +509,6 @@ def _register_all():
         (41, coord.CandidacyRequest), (42, coord.LeaderReply),
         (43, rk.RateInfoReply), (44, rk.QueueStatsReply),
         (45, ClusterConfig),
+        (46, I.GetValuesRequest), (47, I.GetValuesReply),
     ]:
         register(tid, cls)
