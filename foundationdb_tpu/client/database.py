@@ -186,7 +186,9 @@ class Database:
         return Endpoint(addr, token)
 
     def _grv(self) -> Future:
-        """Batched read-version fetch (readVersionBatcher :2709)."""
+        """Batched read-version fetch (readVersionBatcher :2709). Fixed-
+        interval flushes, several allowed in flight: serializing rounds
+        behind one RTT measurably hurts tail latency under commit load."""
         f = Future()
         self._grv_waiters.append(f)
         if not self._grv_armed:
@@ -262,9 +264,11 @@ class Database:
                 raise
         raise FDBError("wrong_shard_server", "location cache cannot converge")
 
-    def _get_value(self, req: GetValueRequest) -> Future:
+    def _read_get(self, key: bytes, version: int) -> Future:
+        """Batched point read resolving to the RAW value (bytes | None) —
+        one future per read, shared all the way to the caller."""
         f = Future()
-        self._read_queue.append((req.key, req.version, f))
+        self._read_queue.append((key, version, f))
         if len(self._read_queue) >= KNOBS.READ_BATCH_MAX:
             queue, self._read_queue = self._read_queue, []
             self.process.spawn(self._send_read_batches(queue), "readBatch")
@@ -300,13 +304,21 @@ class Database:
     def _read_fallback(self, k: bytes, v: int, f: Future):
         """Single-key path for a read that fell out of a batch: re-resolves
         the location cache and fails over on its own."""
-        self._chain(f, self.loop.spawn(self._storage_request(
+        inner = self.loop.spawn(self._storage_request(
             k, Token.STORAGE_GET_VALUE,
-            GetValueRequest(key=k, version=v)), "getValue"))
+            GetValueRequest(key=k, version=v)), "getValue")
+
+        def relay(s):
+            if f.is_ready():
+                return
+            if s.is_error():
+                f._set_error(s._result)
+            else:
+                f._set(s._result.value)
+        inner.add_callback(relay)
 
     async def _send_read_group(self, team: list[str], ents):
-        from foundationdb_tpu.server.interfaces import (
-            GetValueReply, GetValuesRequest)
+        from foundationdb_tpu.server.interfaces import GetValuesRequest
         req = GetValuesRequest(reads=[(k, v) for k, v, _f in ents])
         try:
             rep = await self._on_team(
@@ -327,7 +339,7 @@ class Database:
             if f.is_ready():
                 continue
             if code == 0:
-                f._set(GetValueReply(value=payload, version=v))
+                f._set(payload)
             elif payload == "wrong_shard_server" and self.coordinators:
                 # only this key's shard moved: re-resolve it individually
                 self.locations.invalidate()
@@ -335,16 +347,6 @@ class Database:
             else:
                 f._set_error(FDBError(payload))
 
-    @staticmethod
-    def _chain(dst: Future, src: Future):
-        def relay(s):
-            if dst.is_ready():
-                return
-            if s.is_error():
-                dst._set_error(s._result)
-            else:
-                dst._set(s._result)
-        src.add_callback(relay)
 
     def _get_range(self, req: GetKeyValuesRequest) -> Future:
         return self.loop.spawn(self._get_range_shards(req), "getRangeShards")

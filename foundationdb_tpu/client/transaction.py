@@ -15,7 +15,7 @@ from __future__ import annotations
 from foundationdb_tpu.client.writemap import WriteMap
 from foundationdb_tpu.server.interfaces import (
     CommitTransactionRequest, GetKeyValuesRequest, GetReadVersionRequest,
-    GetValueRequest, KeySelector, Token, WatchValueRequest)
+    KeySelector, Token, WatchValueRequest)
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.types import ATOMIC_OPS, MutationType
@@ -57,10 +57,9 @@ class Transaction:
         if cleared:
             return None
         version = await self.get_read_version()
-        reply = await self.db._get_value(GetValueRequest(key=key, version=version))
+        base = await self.db._read_get(key, version)
         if not snapshot:
             self._read_conflicts.append((key, key + b"\x00"))
-        base = reply.value
         if has_point:
             return point.resolve(base)  # pending atomic ops over storage value
         return base
@@ -88,20 +87,19 @@ class Transaction:
             # no read version yet: fall back to the coroutine path (it
             # fetches one); callers batching reads fetch the GRV first
             return self.db.loop.spawn(self.get(key, snapshot), "get")
-        inner = self.db._get_value(
-            GetValueRequest(key=key, version=self._read_version))
+        inner = self.db._read_get(key, self._read_version)
         if not snapshot:
             self._read_conflicts.append((key, key + b"\x00"))
+        if not has_point:
+            return inner  # the batcher's future IS the result future
 
         def relay(f):
             if out.is_ready():
                 return
             if f.is_error():
                 out._set_error(f._result)
-            elif has_point:
-                out._set(point.resolve(f._result.value))
             else:
-                out._set(f._result.value)
+                out._set(point.resolve(f._result))
         inner.add_callback(relay)
         return out
 

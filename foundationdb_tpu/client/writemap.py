@@ -94,6 +94,8 @@ class WriteMap:
         self._clears = keep
 
     def is_cleared(self, key: bytes) -> bool:
+        if not self._clears:
+            return False  # hot path: read-only transactions
         # bisect on interval begins only: a probe tuple would mis-compare
         # against interval ends that sort above it
         i = bisect.bisect_right(self._clears, key, key=lambda r: r[0]) - 1

@@ -48,6 +48,7 @@ class OracleConflictSet:
         self.keys: list[bytes] = [b""]
         self.vals: list[int] = [_FLOOR]
         self.oldest_version = oldest_version
+        self._gc_countdown = 64  # batches between coalescing sweeps
 
     # -- step function primitives --
     def _seg_of(self, key: bytes) -> int:
@@ -76,11 +77,21 @@ class OracleConflictSet:
         for i in range(i0, i1):
             self.vals[i] = max(self.vals[i], version)
 
-    def remove_before(self, version: int):
-        """Advance the window floor; clamp + coalesce (removeBefore :665)."""
+    def remove_before(self, version: int, force: bool = False):
+        """Advance the window floor; clamp + coalesce (removeBefore :665).
+
+        The floor ALWAYS advances (it drives TooOld decisions). The
+        clamp-and-coalesce sweep is O(segments) and decision-neutral — a
+        stored value below the floor can never exceed an allowed snapshot —
+        so it runs only periodically (or when forced), the same
+        amortization the reference gets from incremental removeBefore."""
         if version <= self.oldest_version:
             return
         self.oldest_version = version
+        self._gc_countdown -= 1
+        if not force and self._gc_countdown > 0 and len(self.keys) < 65536:
+            return
+        self._gc_countdown = 64
         nk, nv = [], []
         for k, v in zip(self.keys, self.vals):
             # Clamping values below the floor up to the floor is decision-
@@ -133,16 +144,38 @@ class OracleConflictSet:
 
 
 class _RangeSet:
-    """Set of half-open ranges with overlap query (intra-batch write set)."""
+    """Set of half-open ranges with overlap query (intra-batch write set).
+    Kept as sorted disjoint intervals: add/overlaps are O(log n) instead of
+    the naive O(n) scan (which made big batches quadratic)."""
 
     def __init__(self):
-        self._ranges: list[tuple[bytes, bytes]] = []
+        self._begins: list[bytes] = []
+        self._ends: list[bytes] = []
 
     def add(self, begin: bytes, end: bytes):
-        if end > begin:
-            self._ranges.append((begin, end))
+        if end <= begin:
+            return
+        bs, es = self._begins, self._ends
+        lo = bisect_right(bs, begin)
+        if lo > 0 and es[lo - 1] >= begin:
+            lo -= 1  # previous interval touches/overlaps
+        hi = lo
+        n = len(bs)
+        while hi < n and bs[hi] <= end:
+            hi += 1
+        if lo == hi:
+            bs.insert(lo, begin)
+            es.insert(lo, end)
+        else:
+            nb = min(begin, bs[lo])
+            ne = max(end, es[hi - 1])
+            bs[lo:hi] = [nb]
+            es[lo:hi] = [ne]
 
     def overlaps(self, begin: bytes, end: bytes) -> bool:
-        if end <= begin:
+        if end <= begin or not self._begins:
             return False
-        return any(b < end and begin < e for b, e in self._ranges)
+        i = bisect_right(self._begins, begin)
+        if i > 0 and self._ends[i - 1] > begin:
+            return True
+        return i < len(self._begins) and self._begins[i] < end

@@ -82,6 +82,9 @@ class ResolverMap:
 
     def split_ranges(self, ranges: list[tuple[bytes, bytes]]) -> dict[int, list[tuple[bytes, bytes]]]:
         """Partition conflict ranges among resolvers (clipped at boundaries)."""
+        if len(self.boundaries) == 1:
+            nonempty = [r for r in ranges if r[0] < r[1]]
+            return {0: nonempty} if nonempty else {}
         out: dict[int, list[tuple[bytes, bytes]]] = {}
         n = len(self.boundaries)
         for b, e in ranges:

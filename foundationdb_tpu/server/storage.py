@@ -397,6 +397,9 @@ class StorageServer:
                 self._apply_durable(m)
         self.durable_version = target
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
+        # the engine commit stays ON the loop: the sqlite connection is
+        # loop-thread-bound, and an await window here would let reads and
+        # shard changes interleave with a half-committed durability advance
         self.store.commit()
         self.data.forget_before(target)
         popped: set[tuple[str, str]] = set()

@@ -105,7 +105,12 @@ class TLog:
                 self._tag_bytes[tag] = self._tag_bytes.get(tag, 0) + w
         self.known_committed_version = max(self.known_committed_version,
                                            req.known_committed_version)
-        # durable push + commit, then reply (group commit = one sync per batch)
+        # durable push + commit, then reply (group commit = one sync per
+        # batch). The fsync stays ON the loop deliberately: an await here
+        # would let an epoch lock, a peek, or a queue pop interleave with a
+        # half-durable commit (lock-fence bypass, peeks serving non-durable
+        # versions, concurrent DiskQueue mutation) — the atomicity of this
+        # block is load-bearing for recovery correctness.
         seq = self.queue.push(wire.dumps((req.version, req.messages)))
         self.queue.commit()
         self._version_seq.append((req.version, seq))
