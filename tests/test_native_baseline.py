@@ -1,0 +1,31 @@
+"""The measured CPU baseline (native/skiplist_baseline.c) must keep
+building and producing sane numbers — bench.py divides by it."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "foundationdb_tpu", "native", "skiplist_baseline.c")
+
+
+def test_skiplist_baseline_builds_and_runs(tmp_path):
+    exe = str(tmp_path / "skb")
+    try:
+        proc = subprocess.run(["cc", "-O2", "-o", exe, SRC],
+                              capture_output=True, text=True, timeout=120)
+    except FileNotFoundError:
+        pytest.skip("no C toolchain: cc not on PATH")
+    if proc.returncode != 0:
+        pytest.skip(f"no C toolchain: {proc.stderr[-200:]}")
+    out = subprocess.run([exe, "500", "30"], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout.strip())
+    assert rep["txns_per_batch"] == 500 and rep["batches"] == 30
+    assert rep["txns_per_sec"] > 1000
+    # skipListTest's workload statistics: ~5% of txns conflict (sparse
+    # ranges over a 20M keyspace, 125k-txn history window)
+    assert 0.85 <= rep["committed_frac"] <= 0.999, rep
