@@ -109,7 +109,9 @@ class Proxy:
                  tlog_uids: list[str] | None = None,
                  die_on_failure: bool = False,
                  system_snapshot: list | None = None,
-                 storages: list | None = None):
+                 storages: list | None = None,
+                 satellites: list[Endpoint] | None = None,
+                 satellite_uids: list[str] | None = None):
         from foundationdb_tpu.server import systemdata
         self.process = process
         self.loop = process.net.loop
@@ -119,6 +121,13 @@ class Proxy:
         self.resolvers = resolvers
         self.tlogs = tlogs
         self.tlog_uids = tlog_uids or [""] * len(tlogs)
+        # the ILogSystem seam (LogSystem.h:268): pushes fan out through it,
+        # so a satellite log set (synchronously quorumed outside the primary
+        # DC) slots in without touching the commit pipeline
+        from foundationdb_tpu.server.logsystem import LogSystem
+        self.log_system = LogSystem.from_endpoints(
+            process, tlogs, uids=self.tlog_uids, satellites=satellites,
+            satellite_uids=satellite_uids)
         # txnStateStore: the system keyspace subset this proxy caches,
         # seeded from the recovery snapshot (or synthesized from a directly
         # supplied ShardMap in statically-built clusters) and maintained by
@@ -673,17 +682,11 @@ class Proxy:
                         messages.setdefault(t, []).append(bm)
 
             # ---- Phase 4: logging (:835) ----
-            quorum = len(self.tlogs) - KNOBS.TLOG_QUORUM_ANTIQUORUM
-            log_futures = [
-                self.process.net.request(
-                    self.process, tl,
-                    TLogCommitRequest(
-                        prev_version=prev_version, version=commit_version,
-                        messages=messages,
-                        known_committed_version=self.committed_version.get(),
-                        uid=uid))
-                for tl, uid in zip(self.tlogs, self.tlog_uids)]
-            await self._wait_quorum(log_futures, quorum)
+            # push through the log system: per-set quorum (primary
+            # N - antiquorum, plus every satellite set's own quorum)
+            await self.log_system.push(
+                prev_version, commit_version, messages,
+                self.committed_version.get())
             # monotonic: a LATER batch that failed early (before its phase-3
             # gate) already max-set this past batch_n in its except handler;
             # a plain set would throw and abort this healthy batch
@@ -752,25 +755,3 @@ class Proxy:
             return Mutation(MutationType.SET_VALUE, m.param1,
                             substitute_versionstamp(m.param2, stamp))
         return m
-
-    async def _wait_quorum(self, futures, quorum: int):
-        if quorum >= len(futures):
-            await all_of(futures)
-            return
-        done = [0]
-        from foundationdb_tpu.core.future import Future
-        gate = Future()
-
-        def on_done(f):
-            if gate.is_ready():
-                return
-            if f.is_error():
-                gate._set_error(f._result)
-            else:
-                done[0] += 1
-                if done[0] >= quorum:
-                    gate._set(None)
-
-        for f in futures:
-            f.add_callback(on_done)
-        await gate

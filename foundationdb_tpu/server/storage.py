@@ -130,6 +130,9 @@ class StorageServer:
         process.register(Token.STORAGE_SET_SHARDS, self._on_set_shards)
         self._ingest_gate: object | None = None  # set while fetchKeys runs
         self._ingest_idle: object | None = None  # update loop parked handshake
+        from foundationdb_tpu.server.logsystem import PeekCursor
+        self._cursor = PeekCursor(process, self.log_epochs, self.tag,
+                                  self._peek_begin)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
 
     def shutdown(self):
@@ -318,27 +321,13 @@ class StorageServer:
                 if self._ingest_idle is not None and not self._ingest_idle.is_ready():
                     self._ingest_idle._set(None)
                 await self._ingest_gate
-            epoch = self._epoch_for(self._peek_begin + 1)
-            idx = self._peek_rotation % len(epoch.addrs)
-            addr = epoch.addrs[idx]
+            self._cursor.epochs = self.log_epochs
+            self._cursor.begin = self._peek_begin
             recovery_count = self.recovery_count
-            try:
-                # bounded wait: a silently-dropped packet (clog/partition)
-                # must also trigger replica failover, not hang ingestion
-                reply = await loop.timeout(self.process.net.request(
-                    self.process, Endpoint(addr, Token.TLOG_PEEK),
-                    TLogPeekRequest(tag=self.tag, begin=self._peek_begin + 1,
-                                    uid=epoch.uid_of(idx))),
-                    2.0)
-            except FDBError as e:
-                if e.name == "operation_cancelled":
-                    raise  # killed: this loop must die, not zombie past reboot
-                # TLog dead/rebooting/unreachable: fail over to the epoch's
-                # next replica (the reference's peek cursor reconnects via
-                # the log system config)
-                self._peek_rotation += 1
-                await loop.delay(0.5)
-                continue
+            # the cursor owns epoch routing + replica failover
+            # (IPeekCursor / LogSystemPeekCursor); cancellation propagates
+            # so a killed server's loop dies instead of zombieing
+            epoch, reply = await self._cursor.get_more()
             if self.recovery_count != recovery_count:
                 # a rollback/rebind landed while this peek was in flight; the
                 # reply may carry the dead epoch's never-acked versions
