@@ -126,7 +126,8 @@ class PeekCursor:
     the cursor exposes `begin` as a plain attribute."""
 
     def __init__(self, process, epochs: list[LogEpoch], tag: int, begin: int,
-                 timeout: float = 2.0, retry_delay: float = 0.5):
+                 timeout: float = 2.0, retry_delay: float = 0.5,
+                 refresh=None, interrupted=None):
         self.process = process
         self.epochs = epochs
         self.tag = tag
@@ -134,6 +135,18 @@ class PeekCursor:
         self._rotation = 0
         self._timeout = timeout
         self._retry_delay = retry_delay
+        # refresh() -> (epochs, begin): re-read the OWNER's live log-system
+        # view at the top of every attempt, so a recovery that rebinds the
+        # epoch list / rewinds the pull position while this cursor is mid-
+        # retry against a dead replica is observed immediately (the reference
+        # cursor routes every attempt through the live log-system config,
+        # LogSystemPeekCursor.actor.cpp). Without it a kill-during-workload
+        # recovery leaves the cursor spinning on the dead epoch forever.
+        self._refresh = refresh
+        # interrupted() -> bool: yield control between attempts (returns
+        # (None, None)) so the owner can re-check its own gates — e.g. a
+        # fetchKeys splice that must see the update loop parked.
+        self._interrupted = interrupted
 
     def epoch_for(self, version: int) -> LogEpoch:
         for ep in self.epochs:
@@ -143,9 +156,14 @@ class PeekCursor:
 
     async def get_more(self):
         """(epoch, TLogPeekReply) for the page at begin+1; retries/rotates
-        internally on dead or unreachable replicas."""
+        internally on dead or unreachable replicas. Returns (None, None)
+        when `interrupted` fires so the owner can service its gates."""
         loop = self.process.net.loop
         while True:
+            if self._refresh is not None:
+                self.epochs, self.begin = self._refresh()
+            if self._interrupted is not None and self._interrupted():
+                return None, None
             epoch = self.epoch_for(self.begin + 1)
             idx = self._rotation % len(epoch.addrs)
             addr = epoch.addrs[idx]

@@ -71,7 +71,6 @@ class StorageServer:
         # stale location cache re-resolves (storageserver getValueQ's
         # serveGetValueRequests shard check).
         self.shard_ranges = shard_ranges
-        self._peek_rotation = 0  # failover index within an epoch's addrs
         # engine selection (openKVStore dispatch IKeyValueStore.h:66,
         # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
         # WAL (kill-injected durability faults); "ssd" = host B-tree over
@@ -131,8 +130,13 @@ class StorageServer:
         self._ingest_gate: object | None = None  # set while fetchKeys runs
         self._ingest_idle: object | None = None  # update loop parked handshake
         from foundationdb_tpu.server.logsystem import PeekCursor
-        self._cursor = PeekCursor(process, self.log_epochs, self.tag,
-                                  self._peek_begin)
+        self._cursor = PeekCursor(
+            process, self.log_epochs, self.tag, self._peek_begin,
+            # live view: a recovery rebinds log_epochs / rewinds _peek_begin
+            # while the cursor may be mid-retry on a dead replica
+            refresh=lambda: (self.log_epochs, self._peek_begin),
+            # a fetchKeys splice needs the loop parked; bail out of retries
+            interrupted=lambda: self._ingest_gate is not None)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
 
     def shutdown(self):
@@ -181,7 +185,6 @@ class StorageServer:
         # (the master allocates the new epoch's first version above any version
         # a storage server can have seen, masterserver.actor.cpp:858 bump)
         self._peek_begin = rollback_to
-        self._peek_rotation = 0
         self.log_epochs = req.epochs
         reply.send(None)
 
@@ -321,13 +324,15 @@ class StorageServer:
                 if self._ingest_idle is not None and not self._ingest_idle.is_ready():
                     self._ingest_idle._set(None)
                 await self._ingest_gate
-            self._cursor.epochs = self.log_epochs
-            self._cursor.begin = self._peek_begin
             recovery_count = self.recovery_count
             # the cursor owns epoch routing + replica failover
-            # (IPeekCursor / LogSystemPeekCursor); cancellation propagates
-            # so a killed server's loop dies instead of zombieing
+            # (IPeekCursor / LogSystemPeekCursor) and re-reads this server's
+            # live epochs/begin on every attempt via its refresh callable;
+            # cancellation propagates so a killed server's loop dies instead
+            # of zombieing
             epoch, reply = await self._cursor.get_more()
+            if reply is None:
+                continue  # interrupted: re-park on the fetchKeys gate
             if self.recovery_count != recovery_count:
                 # a rollback/rebind landed while this peek was in flight; the
                 # reply may carry the dead epoch's never-acked versions

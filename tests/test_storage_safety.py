@@ -174,3 +174,48 @@ def test_fetchkeys_discards_in_flight_peek():
         assert ss.data.get(b"a060", 60) == b"v"
 
     loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+def test_cursor_mid_retry_observes_new_epochs():
+    """VERDICT r4 regression: a recovery that installs a new epoch list while
+    PeekCursor.get_more() is mid-retry against a dead TLog must be observed
+    on the cursor's NEXT attempt — not only between get_more() calls. The
+    reference cursor routes every attempt through the live log-system config
+    (LogSystemPeekCursor.actor.cpp)."""
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"k%03d" % v, b"v")]) for v in range(1, 51)]
+    ScriptedTLog(tlog_proc, msgs, end=51, kc=50)
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"])
+    client = net.new_process("client:0")
+
+    async def t():
+        from foundationdb_tpu.core.sim import Endpoint
+        await loop.delay(2.0)
+        assert ss.version.get() == 50
+        # kill the only TLog: the cursor is now spinning in its internal
+        # retry loop (timeout + rotate) with no live replica to reach
+        net.kill("tlog:0")
+        await loop.delay(5.0)  # definitely mid-retry now
+        # recovery installs a successor epoch on a NEW tlog process
+        tlog2 = net.new_process("tlog:1")
+        msgs2 = [(v, [_set(b"k%03d" % v, b"v")]) for v in range(51, 71)]
+        ScriptedTLog(tlog2, msgs2, end=71, kc=70)
+        req = SetLogSystemRequest(
+            epochs=[LogEpoch(begin=0, end=50, addrs=["tlog:0"]),
+                    LogEpoch(begin=50, end=None, addrs=["tlog:1"])],
+            rollback_to=50, recovery_count=ss.recovery_count + 1)
+        await net.request(client,
+                          Endpoint("ss:0", Token.STORAGE_SET_LOGSYSTEM), req)
+        # without the mid-retry refresh the cursor spins on tlog:0 forever;
+        # with it, ingestion resumes from the new epoch
+        for _ in range(200):
+            if ss.version.get() >= 70:
+                break
+            await loop.delay(0.5)
+        assert ss.version.get() == 70, ss.version.get()
+        assert ss.data.get(b"k070", 70) == b"v"
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
