@@ -1,15 +1,31 @@
 """End-to-end benchmark: reads/writes/90-10 through the FULL pipeline —
-real asyncio TCP transport, separate OS server processes (txn subsystem +
-storage), ordinary client API with concurrent clients.
+real asyncio TCP transport, separate OS server processes, ordinary client
+API with concurrent clients.
 
-Mirrors the reference's single-core benchmarking methodology
+Mirrors the reference's benchmarking methodology
 (documentation/sphinx/source/benchmarking.rst): N concurrent clients, 10 ops
 per transaction, throughput = ops/s; plus GRV/commit latency percentiles.
 Baselines (BASELINE.md): 46k writes/s, 305k reads/s, 107k ops/s 90/10 —
-single core, 100 clients.
+single core, 100 clients. The reference's number is ONE 2012 core; this
+harness reports a scaled topology (P proxy processes + S storage processes +
+one conflict engine) and says so in the report — beating one old core with
+N host processes plus one TPU is the point of a scale-out design.
 
-Run standalone (`python bench_e2e.py`) for a JSON report, or via bench.py
-which folds the numbers into its one-line output.
+Topology (one OS process each):
+  core     — master + resolver + tlog (the resolver hosts the conflict
+             engine; with --backend device that engine is the TPU kernel)
+  proxy0..P — commit/GRV front ends
+  storage0..S — storage servers, keyspace split into S shards
+  client0..K — worker processes driving `clients/K` concurrent actors each
+             (one Python process cannot generate enough load to saturate
+             the pipeline; the reference uses multi-process clients for the
+             same reason, benchmarking.rst "multiple client processes")
+
+Latency percentiles are aggregated across workers by weighted averaging of
+per-worker percentiles (approximate, fine at bench granularity).
+
+Run standalone (`python bench_e2e.py [backend ...]`) for a JSON report, or
+via bench.py which folds the numbers into its one-line output.
 """
 
 from __future__ import annotations
@@ -23,6 +39,8 @@ import tempfile
 import time
 
 BASELINES = {"write": 46_000.0, "read": 305_000.0, "mixed": 107_000.0}
+KEYS = 2000
+_SELF = os.path.abspath(__file__)
 
 
 def _free_port() -> int:
@@ -33,68 +51,239 @@ def _free_port() -> int:
     return port
 
 
-def _boot_cluster(tmp):
+def _spawn_server(spec: dict, env: dict) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.net.server_main",
+         json.dumps(spec)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+
+
+def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2):
     from foundationdb_tpu.server.interfaces import Token
 
-    p_txn = f"127.0.0.1:{_free_port()}"
-    p_storage = f"127.0.0.1:{_free_port()}"
-    txn_spec = {
-        "listen": p_txn,
-        "data_dir": os.path.join(tmp, "txn"),
-        "knobs": {"CONFLICT_BACKEND": "oracle"},
+    txn_knobs = {"CONFLICT_BACKEND": backend}
+    if backend != "oracle":
+        # Device-worthy batching: each conflict step costs ~the same device
+        # time regardless of how few txns it carries (the sort is state-
+        # capacity-dominated), so the commit batcher must accumulate LARGE
+        # batches — a 20ms window turns thousands of tiny batches/s into
+        # tens of full ones. 256-txn pooled chunks fit every real batch
+        # (<= 10 ranges/txn), and the state capacity is sized to the
+        # keyspace's segment count rather than the default 64k.
+        # 10 ranges/txn so a full commit batch is ONE device step (dispatch
+        # and step cost are per-step, not per-txn)
+        txn_knobs.update({"CONFLICT_BATCH_TXNS": 256,
+                          "CONFLICT_BATCH_READS_PER_TXN": 10,
+                          "CONFLICT_BATCH_WRITES_PER_TXN": 10,
+                          "CONFLICT_STATE_CAPACITY": 8192})
+    batch_knobs = {}
+    if backend != "oracle":
+        batch_knobs["COMMIT_TRANSACTION_BATCH_INTERVAL_MIN"] = 0.02
+
+    p_core = f"127.0.0.1:{_free_port()}"
+    # n_proxies=0: merged topology — the proxy lives in the core process
+    # (fewer processes beats parallelism when the host has few cores; on a
+    # one-core host every extra process is pure context-switch overhead)
+    merged = n_proxies == 0
+    p_proxies = ([p_core] if merged
+                 else [f"127.0.0.1:{_free_port()}" for _ in range(n_proxies)])
+    p_storages = [f"127.0.0.1:{_free_port()}" for _ in range(n_storage)]
+
+    # keyspace split into n_storage contiguous shards over k%06d
+    cut_keys = [b"k%06d" % (KEYS * i // n_storage)
+                for i in range(1, n_storage)]
+    boundaries = [b""] + cut_keys
+    shard_spec = {"boundaries": [b.hex() for b in boundaries],
+                  "tags": [[t] for t in range(n_storage)]}
+
+    def proxy_role(i, addr):
+        return {"role": "proxy", "args": {
+            "proxy_id": i,
+            "n_proxies": max(n_proxies, 1),
+            "other_proxies": [a for a in p_proxies if a != addr],
+            "master": {"address": p_core,
+                       "token": Token.MASTER_GET_COMMIT_VERSION},
+            "resolvers": {"boundaries": [b"".hex()],
+                          "endpoints": [{"address": p_core,
+                                         "token": Token.RESOLVER_RESOLVE}]},
+            "tlogs": [{"address": p_core, "token": Token.TLOG_COMMIT}],
+            "shards": shard_spec,
+        }}
+
+    core_spec = {
+        "listen": p_core,
+        "data_dir": os.path.join(tmp, "core"),
+        "knobs": dict(txn_knobs, **batch_knobs),
         "roles": [
             {"role": "master", "args": {}},
-            {"role": "resolver", "args": {}},
+            {"role": "resolver", "args": {"n_proxies": max(n_proxies, 1)}},
             {"role": "tlog", "args": {}},
-            {"role": "proxy", "args": {
-                "proxy_id": 0,
-                "master": {"address": p_txn,
-                           "token": Token.MASTER_GET_COMMIT_VERSION},
-                "resolvers": {"boundaries": [b"".hex()],
-                              "endpoints": [{"address": p_txn,
-                                             "token": Token.RESOLVER_RESOLVE}]},
-                "tlogs": [{"address": p_txn, "token": Token.TLOG_COMMIT}],
-                "shards": {"boundaries": [b"".hex()], "tags": [[0]]},
-            }},
-        ],
+        ] + ([proxy_role(0, p_core)] if merged else []),
     }
-    storage_spec = {
-        "listen": p_storage,
-        "data_dir": os.path.join(tmp, "storage"),
-        "knobs": {"CONFLICT_BACKEND": "oracle"},
-        "roles": [{"role": "storage",
-                   "args": {"tag": 0, "tlog_addrs": [p_txn]}}],
-    }
+    proxy_specs = []
+    if not merged:
+        for i, addr in enumerate(p_proxies):
+            proxy_specs.append({
+                "listen": addr,
+                "data_dir": os.path.join(tmp, f"proxy{i}"),
+                "knobs": batch_knobs,
+                "roles": [proxy_role(i, addr)],
+            })
+    storage_specs = []
+    for t, addr in enumerate(p_storages):
+        storage_specs.append({
+            "listen": addr,
+            "data_dir": os.path.join(tmp, f"storage{t}"),
+            "roles": [{"role": "storage",
+                       "args": {"tag": t, "tlog_addrs": [p_core]}}],
+        })
+
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
-    procs = []
-    for spec in (txn_spec, storage_spec):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "foundationdb_tpu.net.server_main",
-             json.dumps(spec)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env))
+               PYTHONPATH=os.path.dirname(_SELF))
+    # the core process hosts the resolver: for the device backend it takes
+    # whatever accelerator jax finds (the real TPU on the bench box, CPU
+    # otherwise); proxy/storage/client processes stay off the device. The
+    # persistent compile cache makes the boot-time warmup compile a
+    # once-per-machine cost.
+    core_env = dict(env)
+    if backend != "oracle":
+        core_env.pop("JAX_PLATFORMS", None)
+        core_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                            "/tmp/fdb_tpu_jax_cache")
+        core_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                            "1.0")
+    procs = [_spawn_server(core_spec, core_env)]
+    for spec in proxy_specs + storage_specs:
+        procs.append(_spawn_server(spec, env))
     for p in procs:
         line = p.stdout.readline().decode()
         assert line.startswith("ready"), line
-    return procs, p_txn, p_storage
+    return procs, p_proxies, boundaries, p_storages
 
 
-def run(clients: int = 100, seconds: float = 4.0) -> dict:
-    """One pass per phase (write, read, 90/10); returns the report dict."""
+# ---------------------------------------------------------------- client side
+
+def _make_db(loop, proxies, boundaries, storages):
     from foundationdb_tpu.client.database import Database, LocationCache
-    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.net.transport import NetTransport
+
+    client = NetTransport(loop, f"127.0.0.1:{_free_port()}")
+    client.start()
+    db = Database(client.process, proxies=list(proxies),
+                  locations=LocationCache(list(boundaries),
+                                          [[s] for s in storages]))
+    return client, db
+
+
+async def _run_phase(loop, db, kind, clients, seconds):
+    """Drive `clients` concurrent actors for `seconds`; returns
+    (ops, grv_latencies, commit_latencies)."""
+    from foundationdb_tpu.core.future import all_of
+
+    stop_at = time.perf_counter() + seconds
+    ops = [0]
+    grv_lat: list[float] = []
+    commit_lat: list[float] = []
+
+    async def one_client(cid):
+        import random
+        rng = random.Random(cid)
+        while time.perf_counter() < stop_at:
+            tr = db.create_transaction()
+            try:
+                t0 = time.perf_counter()
+                await tr.get_read_version()
+                grv_lat.append(time.perf_counter() - t0)
+                n = 10
+                wrote = False
+                reads = []
+                for i in range(n):
+                    if kind == "write" or (kind == "mixed"
+                                           and rng.random() < 0.1):
+                        tr.set(b"k%06d" % rng.randrange(KEYS), b"w" * 16)
+                        wrote = True
+                    else:
+                        reads.append(b"k%06d" % rng.randrange(KEYS))
+                if reads:
+                    # issue a txn's reads concurrently as futures — the
+                    # reference's client API shape (fdb_transaction_get ->
+                    # FDBFuture; its bench clients wait on N futures)
+                    await all_of([tr.get_future(k) for k in reads])
+                if wrote:
+                    t1 = time.perf_counter()
+                    await tr.commit()
+                    commit_lat.append(time.perf_counter() - t1)
+                ops[0] += n
+            except Exception:
+                pass  # retries are the app's concern; keep pumping
+
+    tasks = [loop.spawn(one_client(c), name=f"bench{c}")
+             for c in range(clients)]
+    for t in tasks:
+        await t
+    return ops[0], grv_lat, commit_lat
+
+
+def _pcts(lat: list[float]) -> dict:
+    if not lat:
+        return {}
+    lat.sort()
+    return {"p50": 1e3 * lat[len(lat) // 2],
+            "p99": 1e3 * lat[int(len(lat) * 0.99)],
+            "n": len(lat)}
+
+
+def worker_main(spec: dict):
+    """One client worker process: wait for GO on stdin (synchronized start
+    across workers), run one phase, print a JSON result line."""
+    from foundationdb_tpu.net.transport import RealEventLoop
+
+    loop = RealEventLoop()
+    client, db = _make_db(loop, spec["proxies"],
+                          [bytes.fromhex(b) for b in spec["boundaries"]],
+                          spec["storages"])
+    print("ready", flush=True)
+    assert sys.stdin.readline().strip() == "GO"
+
+    async def main():
+        return await _run_phase(loop, db, spec["kind"], spec["clients"],
+                                spec["seconds"])
+
+    ops, grv, com = loop.run_future(loop.spawn(main()),
+                                    max_time=60.0 + spec["seconds"])
+    client.close()
+    print(json.dumps({"ops": ops, "grv": _pcts(grv), "commit": _pcts(com)}),
+          flush=True)
+
+
+def _merge_pcts(parts: list[dict]) -> dict:
+    """Count-weighted average of per-worker percentiles (approximate)."""
+    parts = [p for p in parts if p]
+    total = sum(p["n"] for p in parts)
+    if not total:
+        return {}
+    return {k: round(sum(p[k] * p["n"] for p in parts) / total, 2)
+            for k in ("p50", "p99")}
+
+
+def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
+        n_proxies: int = 0, n_storage: int = 1,
+        n_client_procs: int = 2) -> dict:
+    """One pass per phase (write, read, 90/10); returns the report dict."""
+    from foundationdb_tpu.net.transport import RealEventLoop
 
     tmp = tempfile.mkdtemp(prefix="fdbtpu-bench-")
-    procs, p_txn, p_storage = _boot_cluster(tmp)
-    report: dict = {"clients": clients}
+    procs, p_proxies, boundaries, p_storages = _boot_cluster(
+        tmp, backend, n_proxies, n_storage)
+    report: dict = {"clients": clients, "conflict_backend": backend,
+                    "topology": {"proxies": n_proxies, "storage": n_storage,
+                                 "client_procs": n_client_procs}}
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(_SELF))
     try:
+        # preload with an in-process client
         loop = RealEventLoop()
-        client = NetTransport(loop, f"127.0.0.1:{_free_port()}")
-        client.start()
-        db = Database(client.process, proxies=[p_txn],
-                      locations=LocationCache([b""], [[p_storage]]))
-
-        KEYS = 2000
+        client, db = _make_db(loop, p_proxies, boundaries, p_storages)
 
         async def preload():
             for base in range(0, KEYS, 100):
@@ -103,80 +292,43 @@ def run(clients: int = 100, seconds: float = 4.0) -> dict:
                         tr.set(b"k%06d" % i, b"v" * 16)
                 await db.transact(w, max_retries=100)
 
-        async def phase(kind):
-            stop_at = time.perf_counter() + seconds
-            ops = [0]
-            grv_lat: list[float] = []
-            commit_lat: list[float] = []
-
-            from foundationdb_tpu.core.future import all_of
-
-            async def one_client(cid):
-                import random
-                rng = random.Random(cid)
-                while time.perf_counter() < stop_at:
-                    tr = db.create_transaction()
-                    try:
-                        t0 = time.perf_counter()
-                        await tr.get_read_version()
-                        grv_lat.append(time.perf_counter() - t0)
-                        n = 10
-                        wrote = False
-                        reads = []
-                        for i in range(n):
-                            if kind == "write" or (kind == "mixed"
-                                                   and rng.random() < 0.1):
-                                tr.set(b"k%06d" % rng.randrange(KEYS),
-                                       b"w" * 16)
-                                wrote = True
-                            else:
-                                reads.append(b"k%06d" % rng.randrange(KEYS))
-                        if reads:
-                            # issue a txn's reads concurrently as futures —
-                            # the reference's client API shape
-                            # (fdb_transaction_get -> FDBFuture; its bench
-                            # clients wait on N outstanding futures)
-                            await all_of([tr.get_future(k) for k in reads])
-                        if wrote:
-                            t1 = time.perf_counter()
-                            await tr.commit()
-                            commit_lat.append(time.perf_counter() - t1)
-                        ops[0] += n
-                    except Exception:
-                        pass  # retries are the app's concern; keep pumping
-
-            tasks = [loop.spawn(one_client(c), name=f"bench{c}")
-                     for c in range(clients)]
-            for t in tasks:
-                await t
-            return ops[0], grv_lat, commit_lat
-
-        async def main():
-            await preload()
-            out = {}
-            for kind in ("write", "read", "mixed"):
-                n, grv, com = await phase(kind)
-                rate = n / seconds
-                entry = {"ops_per_sec": round(rate, 1),
-                         "vs_baseline": round(rate / BASELINES[kind], 3)}
-                if grv:
-                    grv.sort()
-                    entry["grv_ms_p50"] = round(
-                        1e3 * grv[len(grv) // 2], 2)
-                    entry["grv_ms_p99"] = round(
-                        1e3 * grv[int(len(grv) * 0.99)], 2)
-                if com:
-                    com.sort()
-                    entry["commit_ms_p50"] = round(
-                        1e3 * com[len(com) // 2], 2)
-                    entry["commit_ms_p99"] = round(
-                        1e3 * com[int(len(com) * 0.99)], 2)
-                out[kind] = entry
-            return out
-
-        report.update(loop.run_future(loop.spawn(main()),
-                                      max_time=120.0 + 3 * seconds))
+        loop.run_future(loop.spawn(preload()), max_time=120.0)
         client.close()
+
+        per = [clients // n_client_procs] * n_client_procs
+        per[0] += clients - sum(per)
+        for kind in ("write", "read", "mixed"):
+            workers = []
+            for k in range(n_client_procs):
+                spec = {"kind": kind, "clients": per[k],
+                        "seconds": seconds, "proxies": p_proxies,
+                        "boundaries": [b.hex() for b in boundaries],
+                        "storages": p_storages}
+                workers.append(subprocess.Popen(
+                    [sys.executable, _SELF, "--worker", json.dumps(spec)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, env=env))
+            for w in workers:
+                assert w.stdout.readline().decode().startswith("ready")
+            for w in workers:
+                w.stdin.write(b"GO\n")
+                w.stdin.flush()
+            results = []
+            for w in workers:
+                line = w.stdout.readline().decode()
+                results.append(json.loads(line))
+                w.wait(timeout=60)
+            rate = sum(r["ops"] for r in results) / seconds
+            entry = {"ops_per_sec": round(rate, 1),
+                     "vs_baseline": round(rate / BASELINES[kind], 3)}
+            grv = _merge_pcts([r["grv"] for r in results])
+            com = _merge_pcts([r["commit"] for r in results])
+            if grv:
+                entry["grv_ms_p50"], entry["grv_ms_p99"] = grv["p50"], grv["p99"]
+            if com:
+                entry["commit_ms_p50"], entry["commit_ms_p99"] = \
+                    com["p50"], com["p99"]
+            report[kind] = entry
     finally:
         for p in procs:
             p.terminate()
@@ -186,4 +338,10 @@ def run(clients: int = 100, seconds: float = 4.0) -> dict:
 
 
 if __name__ == "__main__":
-    print(json.dumps(run(), indent=2))
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        worker_main(json.loads(sys.argv[2]))
+        sys.exit(0)
+    backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
+    out = {b: run(backend=b) for b in backends}
+    print(json.dumps(out if len(backends) > 1 else out[backends[0]],
+                     indent=2))

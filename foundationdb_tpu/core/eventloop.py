@@ -215,6 +215,19 @@ class EventLoop:
             raise FDBError("internal_error", "deadlock: future unresolved and queue empty")
         return fut.get()
 
+    def run_blocking(self, fn) -> Future:
+        """Future of fn()'s value, for host-blocking work (e.g. a device
+        readback). The deterministic sim runs it inline — virtual time does
+        not advance and replay stays exact; RealEventLoop overrides this to
+        a worker thread so the loop keeps serving while the host blocks
+        (the reference's IThreadPool / onMainThread bridge, flow/flow.h)."""
+        out = Future()
+        try:
+            out._set(fn())
+        except BaseException as e:  # noqa: BLE001 — delivered to the awaiter
+            out._set_error(e)
+        return out
+
     def timeout(self, fut: Future, seconds: float) -> Future:
         """Future of fut's value, or error timed_out after `seconds`.
 

@@ -88,6 +88,45 @@ static PyObject *py_crc32c(PyObject *self, PyObject *args) {
 /* Bulk key encoding                                                   */
 /* ------------------------------------------------------------------ */
 
+/* Encode one key into column `col` of a limb-major uint32 buffer with
+ * `cap` columns. Mirrors utils/keys.py encode_key exactly — the single
+ * copy of the round-up length rule both bulk paths share (a divergence
+ * between them would make the device and the host encode the same key
+ * differently). */
+static int encode_key_col(PyObject *keyobj, uint32_t *o, Py_ssize_t cap,
+                          int num_limbs, int key_bytes, int round_up,
+                          Py_ssize_t col) {
+    char *kbuf;
+    Py_ssize_t klen;
+    if (PyBytes_AsStringAndSize(keyobj, &kbuf, &klen) < 0)
+        return -1;
+    uint8_t padded[64];
+    Py_ssize_t use = klen < key_bytes ? klen : key_bytes;
+    memcpy(padded, kbuf, use);
+    memset(padded + use, 0, key_bytes - use);
+    for (int l = 0; l < num_limbs - 1; l++) {
+        const uint8_t *p = padded + 4 * l;
+        o[(Py_ssize_t)l * cap + col] =
+            ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+            ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    }
+    uint32_t lenlimb;
+    if (klen > key_bytes)
+        lenlimb = round_up ? ((uint32_t)key_bytes + 1) : (uint32_t)key_bytes;
+    else
+        lenlimb = (uint32_t)klen;
+    o[(Py_ssize_t)(num_limbs - 1) * cap + col] = lenlimb;
+    return 0;
+}
+
+static int check_key_bytes(int key_bytes) {
+    if (key_bytes <= 0 || key_bytes > 64 || key_bytes % 4 != 0) {
+        PyErr_SetString(PyExc_ValueError, "key_bytes must be in 4..64, /4");
+        return -1;
+    }
+    return 0;
+}
+
 /* encode_keys_into(keys: sequence of bytes, out: writable buffer of
  * uint32[NUM_LIMBS * n] in SoA layout (limb-major), round_up: bool)
  * Mirrors utils/keys.py encode_key exactly. */
@@ -98,9 +137,8 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
     int key_bytes = KEY_BYTES;
     if (!PyArg_ParseTuple(args, "Ow*|pi", &keys, &out, &round_up, &key_bytes))
         return NULL;
-    if (key_bytes <= 0 || key_bytes > 64 || key_bytes % 4 != 0) {
+    if (check_key_bytes(key_bytes) < 0) {
         PyBuffer_Release(&out);
-        PyErr_SetString(PyExc_ValueError, "key_bytes must be in 4..64, /4");
         return NULL;
     }
     int num_limbs = key_bytes / 4 + 1;
@@ -121,29 +159,11 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
 
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
-        char *kbuf;
-        Py_ssize_t klen;
-        if (PyBytes_AsStringAndSize(item, &kbuf, &klen) < 0) {
+        if (encode_key_col(item, o, n, num_limbs, key_bytes, round_up, i) < 0) {
             PyBuffer_Release(&out);
             Py_DECREF(seq);
             return NULL;
         }
-        uint8_t padded[64];
-        Py_ssize_t use = klen < key_bytes ? klen : key_bytes;
-        memcpy(padded, kbuf, use);
-        memset(padded + use, 0, key_bytes - use);
-        for (int l = 0; l < num_limbs - 1; l++) {
-            const uint8_t *p = padded + 4 * l;
-            o[(Py_ssize_t)l * n + i] =
-                ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
-                ((uint32_t)p[2] << 8) | (uint32_t)p[3];
-        }
-        uint32_t lenlimb;
-        if (klen > key_bytes)
-            lenlimb = round_up ? ((uint32_t)key_bytes + 1) : (uint32_t)key_bytes;
-        else
-            lenlimb = (uint32_t)klen;
-        o[(Py_ssize_t)(num_limbs - 1) * n + i] = lenlimb;
     }
     PyBuffer_Release(&out);
     Py_DECREF(seq);
@@ -636,9 +656,127 @@ static PyObject *py_wire_loads(PyObject *self, PyObject *arg) {
     return out;
 }
 
+/* ------------------------------------------------------------------ */
+/* Conflict-batch flattening                                           */
+/*                                                                     */
+/* The device feed path: one C pass walks a batch of transaction       */
+/* conflict infos and writes begin/end keys (limb-encoded, SoA) plus   */
+/* range->txn maps straight into the numpy buffers encode_batch hands  */
+/* to the jitted step. Replaces a per-range Python loop that dominated */
+/* the resolver's host cost at serving batch sizes.                    */
+/* ------------------------------------------------------------------ */
+
+/* encode_conflict_ranges(txns, skip_or_None, rb, re, wb, we, rtxn, wtxn,
+ *                        key_bytes) -> (n_reads, n_writes)
+ * txns: sequence of objects with .read_ranges/.write_ranges = [(b, e), ...]
+ * rb/re/wb/we: writable uint32 buffers (num_limbs x cap, limb-major);
+ * rtxn/wtxn: writable int32 buffers (cap). Raises ValueError on overflow. */
+static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
+    PyObject *txns, *skip;
+    Py_buffer rb, re, wb, we, rtxn, wtxn;
+    int key_bytes = KEY_BYTES;
+    if (!PyArg_ParseTuple(args, "OOw*w*w*w*w*w*|i", &txns, &skip, &rb, &re,
+                          &wb, &we, &rtxn, &wtxn, &key_bytes))
+        return NULL;
+    PyObject *seq = NULL;
+    PyObject *ret = NULL;
+    if (check_key_bytes(key_bytes) < 0)
+        goto done;
+    int num_limbs = key_bytes / 4 + 1;
+    Py_ssize_t rcap = rb.len / (4 * num_limbs);
+    Py_ssize_t wcap = wb.len / (4 * num_limbs);
+    /* every sibling buffer must cover its capacity — rcap/wcap are derived
+     * from rb/wb alone, and writing past a smaller re/we/rtxn/wtxn would be
+     * heap corruption, not an exception */
+    if (re.len < rcap * 4 * num_limbs || we.len < wcap * 4 * num_limbs ||
+        (Py_ssize_t)rtxn.len < rcap * 4 || (Py_ssize_t)wtxn.len < wcap * 4) {
+        PyErr_SetString(PyExc_ValueError, "output buffers disagree on size");
+        goto done;
+    }
+    int32_t *rt = (int32_t *)rtxn.buf;
+    int32_t *wt = (int32_t *)wtxn.buf;
+    Py_ssize_t ri = 0, wi = 0;
+    seq = PySequence_Fast(txns, "txns must be a sequence");
+    if (!seq)
+        goto done;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t t = 0; t < n; t++) {
+        if (skip != Py_None) {
+            int truth = PyObject_IsTrue(PySequence_Fast_GET_ITEM(skip, t));
+            if (truth < 0)
+                goto done;
+            if (truth)
+                continue;
+        }
+        PyObject *txn = PySequence_Fast_GET_ITEM(seq, t);
+        for (int pass = 0; pass < 2; pass++) {
+            PyObject *ranges = PyObject_GetAttrString(
+                txn, pass == 0 ? "read_ranges" : "write_ranges");
+            if (!ranges)
+                goto done;
+            PyObject *rseq = PySequence_Fast(ranges, "ranges");
+            Py_DECREF(ranges);
+            if (!rseq)
+                goto done;
+            Py_ssize_t nr = PySequence_Fast_GET_SIZE(rseq);
+            uint32_t *ob = pass == 0 ? (uint32_t *)rb.buf : (uint32_t *)wb.buf;
+            uint32_t *oe = pass == 0 ? (uint32_t *)re.buf : (uint32_t *)we.buf;
+            Py_ssize_t cap = pass == 0 ? rcap : wcap;
+            Py_ssize_t *idx = pass == 0 ? &ri : &wi;
+            int32_t *map = pass == 0 ? rt : wt;
+            if (*idx + nr > cap) {
+                Py_DECREF(rseq);
+                PyErr_SetString(PyExc_ValueError,
+                                "conflict range capacity exceeded");
+                goto done;
+            }
+            for (Py_ssize_t j = 0; j < nr; j++) {
+                PyObject *pair = PySequence_Fast_GET_ITEM(rseq, j);
+                PyObject *kb, *ke;
+                if (PyTuple_CheckExact(pair) && PyTuple_GET_SIZE(pair) == 2) {
+                    kb = PyTuple_GET_ITEM(pair, 0);
+                    ke = PyTuple_GET_ITEM(pair, 1);
+                } else if (PyList_CheckExact(pair) &&
+                           PyList_GET_SIZE(pair) == 2) {
+                    kb = PyList_GET_ITEM(pair, 0);
+                    ke = PyList_GET_ITEM(pair, 1);
+                } else {
+                    Py_DECREF(rseq);
+                    PyErr_SetString(PyExc_TypeError,
+                                    "range must be a (begin, end) pair");
+                    goto done;
+                }
+                if (encode_key_col(kb, ob, cap, num_limbs, key_bytes, 0,
+                                   *idx) < 0 ||
+                    encode_key_col(ke, oe, cap, num_limbs, key_bytes, 1,
+                                   *idx) < 0) {
+                    Py_DECREF(rseq);
+                    goto done;
+                }
+                map[*idx] = (int32_t)t;
+                (*idx)++;
+            }
+            Py_DECREF(rseq);
+        }
+    }
+    ret = Py_BuildValue("(nn)", ri, wi);
+done:
+    Py_XDECREF(seq);
+    PyBuffer_Release(&rb);
+    PyBuffer_Release(&re);
+    PyBuffer_Release(&wb);
+    PyBuffer_Release(&we);
+    PyBuffer_Release(&rtxn);
+    PyBuffer_Release(&wtxn);
+    return ret;
+}
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
+    {"encode_conflict_ranges", py_encode_conflict_ranges, METH_VARARGS,
+     "encode_conflict_ranges(txns, skip_or_None, rb, re, wb, we, rtxn, "
+     "wtxn, key_bytes) -> (n_reads, n_writes)"},
     {"encode_keys_into", py_encode_keys_into, METH_VARARGS,
      "encode_keys_into(keys, out_u32_buffer, round_up=False, key_bytes=24)\nkey_bytes MUST match the buffer layout: out has key_bytes/4+1 limb rows."},
     {"wire_set_registry", py_wire_set_registry, METH_VARARGS,

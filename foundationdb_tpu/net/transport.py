@@ -53,9 +53,31 @@ class RealEventLoop(EventLoop):
     def __init__(self):
         super().__init__()
         self.aio = asyncio.new_event_loop()
+        self._pool = None  # lazily-built thread pool for run_blocking
 
     def now(self) -> float:
         return time.monotonic()
+
+    def run_blocking(self, fn) -> Future:
+        """Run fn() on a worker thread; the loop keeps serving meanwhile.
+        Used for device-result readbacks on the commit path — blocking the
+        only loop thread on a TPU sync would stall GRV/reads/ingestion."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="fdbtpu-blocking")
+        out = Future()
+
+        def resolve(cf):
+            e = cf.exception()
+            if e is not None:
+                out._set_error(e)
+            else:
+                out._set(cf.result())
+
+        self._pool.submit(fn).add_done_callback(
+            lambda cf: self.aio.call_soon_threadsafe(resolve, cf))
+        return out
 
     def _schedule(self, delay: float, priority: int, fn):
         if delay <= 0.0:

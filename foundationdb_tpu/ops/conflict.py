@@ -55,7 +55,8 @@ from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 
 L = keylib.NUM_LIMBS  # default key limbs (6 data + 1 length; see ConflictShapes.key_bytes)
-NEG = jnp.int32(-(1 << 30))  # "no version" sentinel, below any clamped offset
+_NEG_INT = -(1 << 30)
+NEG = jnp.int32(_NEG_INT)  # "no version" sentinel, below any clamped offset
 _REBASE_THRESHOLD = 1 << 29
 
 
@@ -621,16 +622,42 @@ class BatchEncoder:
 
     def _clamp_off(self, version: int) -> int:
         off = version - self.base_version
-        return int(max(min(off, (1 << 31) - 1), int(NEG)))
+        return int(max(min(off, (1 << 31) - 1), _NEG_INT))
+
+    def bucket_shapes(self, nr: int, nw: int) -> ConflictShapes:
+        """Smallest shape bucket covering a chunk with nr reads / nw writes.
+
+        Serving batches are usually far smaller than the configured maximum
+        (and often one-sided: write-only batches carry zero read ranges), so
+        padding every dispatch to the full shape wastes transfer bytes and
+        device sort rows. Two buckets per axis (full/16 and full) bound the
+        compiled-program count at 4 — the TPU-serving bucketed-padding
+        pattern; warmup() pre-compiles all of them."""
+        import dataclasses
+        sh = self.shapes
+
+        def pick(n, full):
+            small = max(full // 16, 8)
+            return small if n <= small else full
+        r, w = pick(nr, sh.reads), pick(nw, sh.writes)
+        if (r, w) == (sh.reads, sh.writes):
+            return sh
+        return dataclasses.replace(sh, reads=r, writes=w)
 
     def encode_batch(self, txns: list[TxnConflictInfo], commit_version: int,
-                     skip: list[bool] | None = None):
+                     skip: list[bool] | None = None,
+                     shapes: ConflictShapes | None = None):
         """Build one device batch. Key encoding is bulk (C extension when
         available — feeding the device is a host hot path, the analogue of
         the reference's C++ key juggling in SkipList.cpp addTransaction)."""
-        sh = self.shapes
+        sh = shapes or self.shapes
         T = sh.txns
         assert len(txns) <= T
+        if not sh.strided:
+            from foundationdb_tpu import native
+            if native.available() and hasattr(native.mod,
+                                              "encode_conflict_ranges"):
+                return self._encode_batch_c(txns, commit_version, skip, sh)
         rkeys_b: list[bytes] = []
         rkeys_e: list[bytes] = []
         wkeys_b: list[bytes] = []
@@ -661,6 +688,10 @@ class BatchEncoder:
         re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
         wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
         we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
+        # Leaves stay HOST numpy: the jitted step's implicit argument
+        # transfer is asynchronous and batched (sub-ms enqueue), while an
+        # explicit device_put per leaf costs a synchronous handshake each —
+        # on a remote-attached device that is milliseconds per leaf.
         if sh.strided:
             # ranges land at their txn's stride slots; rtxn/wtxn are implied
             # by position and ignored by the kernel (cached device constants)
@@ -669,13 +700,13 @@ class BatchEncoder:
             _bulk_encode_at(wkeys_b, wt, wb, round_up=False)
             _bulk_encode_at(wkeys_e, wt, we, round_up=True)
             return {
-                "rb": jnp.asarray(rb), "re": jnp.asarray(re),
+                "rb": rb, "re": re,
                 "rtxn": self._strided_rtxn,
-                "wb": jnp.asarray(wb), "we": jnp.asarray(we),
+                "wb": wb, "we": we,
                 "wtxn": self._strided_wtxn,
-                "snapshot": jnp.asarray(snap), "txn_valid": jnp.asarray(valid),
-                "commit_version": jnp.int32(self._clamp_off(commit_version)),
-                "advance_floor": jnp.asarray(True),
+                "snapshot": snap, "txn_valid": valid,
+                "commit_version": np.int32(self._clamp_off(commit_version)),
+                "advance_floor": np.bool_(True),
             }
         _bulk_encode(rkeys_b, rb, round_up=False)
         _bulk_encode(rkeys_e, re, round_up=True)
@@ -686,11 +717,43 @@ class BatchEncoder:
         rtxn[: len(rt)] = rt
         wtxn[: len(wt)] = wt
         return {
-            "rb": jnp.asarray(rb), "re": jnp.asarray(re), "rtxn": jnp.asarray(rtxn),
-            "wb": jnp.asarray(wb), "we": jnp.asarray(we), "wtxn": jnp.asarray(wtxn),
-            "snapshot": jnp.asarray(snap), "txn_valid": jnp.asarray(valid),
-            "commit_version": jnp.int32(self._clamp_off(commit_version)),
-            "advance_floor": jnp.asarray(True),
+            "rb": rb, "re": re, "rtxn": rtxn,
+            "wb": wb, "we": we, "wtxn": wtxn,
+            "snapshot": snap, "txn_valid": valid,
+            "commit_version": np.int32(self._clamp_off(commit_version)),
+            "advance_floor": np.bool_(True),
+        }
+
+    def _encode_batch_c(self, txns: list[TxnConflictInfo],
+                        commit_version: int, skip: list[bool] | None,
+                        sh: ConflictShapes):
+        """Pooled-layout encode with the C flattener: one native pass writes
+        keys (limb-encoded) + range→txn maps straight into the buffers,
+        replacing the per-range Python loop (the host hot path when the
+        device engine serves live commit batches)."""
+        from foundationdb_tpu import native
+        T = sh.txns
+        rb = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
+        re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
+        wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
+        we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
+        rtxn = np.full(sh.reads, T, np.int32)
+        wtxn = np.full(sh.writes, T, np.int32)
+        native.mod.encode_conflict_ranges(
+            txns, skip, rb, re, wb, we, rtxn, wtxn, (self.L - 1) * 4)
+        snap = np.zeros(T, np.int32)
+        valid = np.zeros(T, bool)
+        for t, txn in enumerate(txns):
+            if skip is not None and skip[t]:
+                continue
+            valid[t] = True
+            snap[t] = self._clamp_off(txn.read_snapshot)
+        return {
+            "rb": rb, "re": re, "rtxn": rtxn,
+            "wb": wb, "we": we, "wtxn": wtxn,
+            "snapshot": snap, "txn_valid": valid,
+            "commit_version": np.int32(self._clamp_off(commit_version)),
+            "advance_floor": np.bool_(True),
         }
 
     def split_for_capacity(self, txns):
@@ -741,17 +804,36 @@ def detect_async_impl(engine, txns: list[TxnConflictInfo],
     # offsets saturate across extreme rebases); flagged txns are excluded
     # from the device batch entirely.
     pre_batch_oldest = engine.oldest_version
+    base = enc.base_version
     chunks = []
     for i, sub in enumerate(subs):
-        host_too_old = [bool(t.read_ranges) and t.read_snapshot < pre_batch_oldest
+        # TOO_OLD when below the MVCC floor, AND when the snapshot's device
+        # offset would saturate at the NEG sentinel (a >2^30-stale snapshot
+        # after a rebase): a saturated snapshot compares equal to "no
+        # version" and would silently MISS conflicts — rejecting it is the
+        # conservative direction (the reference also throws too_old for
+        # anything beyond its window, SkipList.cpp:985 semantics)
+        host_too_old = [bool(t.read_ranges)
+                        and (t.read_snapshot < pre_batch_oldest
+                             or t.read_snapshot - base <= _NEG_INT)
                         for t in sub]
-        batch = enc.encode_batch(sub, commit_version, skip=host_too_old)
+        nr = sum(len(t.read_ranges) for t, old in zip(sub, host_too_old)
+                 if not old)
+        nw = sum(len(t.write_ranges) for t, old in zip(sub, host_too_old)
+                 if not old)
+        shapes, step = engine.plan_chunk(nr, nw)
+        batch = enc.encode_batch(sub, commit_version, skip=host_too_old,
+                                 shapes=shapes)
         # the MVCC floor advances once per logical batch (last chunk), so
         # every chunk's too-old check uses the pre-batch floor
-        batch["advance_floor"] = jnp.asarray(i == len(subs) - 1)
-        new_state, statuses, info = engine._step(engine._state, batch)
+        batch["advance_floor"] = np.bool_(i == len(subs) - 1)
+        new_state, statuses, info = step(engine._state, batch)
         engine._state = new_state
-        chunks.append((len(sub), host_too_old, statuses, info))
+        # statuses + overflow fused into ONE fixed-shape device array
+        # (enqueue-only): every chunk is read back as a single transfer, and
+        # drain_handles can overlap those transfers across batches
+        chunks.append((len(sub), host_too_old,
+                       _combine_status(statuses, info["overflow"])))
     # the kernel's floor advance is replicated host-side exactly
     # (floor = commit_version - window on the last chunk, monotonic max)
     engine.oldest_version = max(
@@ -803,11 +885,70 @@ class DeviceConflictSet:
                      commit_version: int) -> "DetectHandle":
         return detect_async_impl(self, txns, commit_version)
 
+    def plan_chunk(self, nr: int, nw: int):
+        """(shapes, compiled step) for a chunk: bucketed padding keeps the
+        transfer bytes and the device sort sized to the chunk, not to the
+        configured maximum (see BatchEncoder.bucket_shapes)."""
+        shapes = (self.encoder.bucket_shapes(nr, nw)
+                  if not self.shapes.strided else self.shapes)
+        return shapes, _compiled_step(
+            shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+
+    def warmup(self):
+        """Compile every serving bucket now (boot-time cost, served-path
+        savings; the persistent compile cache makes it once per machine)."""
+        sh = self.shapes
+        if sh.strided:
+            self.detect([], self.encoder.base_version + 1)
+            return
+        combos = {(r, w)
+                  for r in (0, sh.reads) for w in (0, sh.writes)}
+        for nr, nw in combos:
+            shapes, step = self.plan_chunk(nr, nw)
+            batch = self.encoder.encode_batch(
+                [], self.encoder.base_version + 1, shapes=shapes)
+            new_state, statuses, _info = step(self._state, batch)
+            self._state = new_state
+            statuses.block_until_ready()
+
     def clear(self, oldest_version: int = 0):
         """clearConflictSet (SkipList.cpp:957): state is soft/reconstructable."""
         self.encoder.base_version = oldest_version
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)
+
+
+@functools.cache
+def _combine_fn():
+    # one program per process: statuses is always (shapes.txns,), overflow a
+    # scalar — the fixed output shape keeps the tunnel's compile cache warm
+    return jax.jit(lambda s, o: jnp.concatenate(
+        [s.astype(jnp.int32), jnp.asarray(o, jnp.int32)[None]]))
+
+
+def _combine_status(statuses, overflow):
+    return _combine_fn()(statuses, overflow)
+
+
+def drain_handles(handles: list["DetectHandle"]) -> None:
+    """Materialize many DetectHandles with overlapped device→host copies.
+
+    Each pending chunk's combined status array gets an ASYNC host copy
+    enqueued first; the materializing np.asarray then finds the data already
+    in flight, so N batches' readbacks cost ~one device round trip total
+    instead of N (dominant on a remote-attached device). result() on each
+    handle afterwards touches no device state. This is the serving-path
+    analogue of conflict_scan's single-readback chaining: round-trip latency
+    is paid once per DRAIN, so resolver throughput is set by dispatch rate,
+    not round-trip time.
+    """
+    pend = [h for h in handles if h._result is None and h._chunks]
+    arrs = [c[2] for h in pend for c in h._chunks]
+    for a in arrs:
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
+    for h in pend:
+        h._chunks = [(n, too_old, np.asarray(a)) for n, too_old, a in h._chunks]
 
 
 class DetectHandle:
@@ -820,8 +961,9 @@ class DetectHandle:
     def result(self) -> list[int]:
         if self._result is None:
             out: list[int] = []
-            for n, host_too_old, statuses, info in self._chunks:
-                if bool(info["overflow"]):
+            for n, host_too_old, combined in self._chunks:
+                arr = np.asarray(combined)  # statuses ++ [overflow]
+                if arr[-1]:
                     # The truncated state dropped the highest-key history
                     # segments and could cause false commits — fatal; the
                     # owner reconstructs (clearConflictSet semantics,
@@ -829,9 +971,8 @@ class DetectHandle:
                     raise FDBError(
                         "internal_error",
                         "conflict state capacity exceeded; raise CONFLICT_STATE_CAPACITY")
-                dev_statuses = np.asarray(statuses[:n])
                 out.extend(TOO_OLD if old else int(s)
-                           for s, old in zip(dev_statuses, host_too_old))
+                           for s, old in zip(arr[:n], host_too_old))
             self._result = out
             self._chunks = None
         return self._result

@@ -239,6 +239,14 @@ class ShardedDeviceConflictSet:
             self._state = core
             self.encoder.base_version += delta
 
+    def plan_chunk(self, nr: int, nw: int):
+        """Mesh program is fixed (sharding specs bake the shapes): no
+        bucketed padding here, unlike the single-device engine."""
+        return self.shapes, self._step
+
+    def warmup(self):
+        self.detect([], self.encoder.base_version + 1)
+
     def detect(self, txns: list[TxnConflictInfo], commit_version: int) -> list[int]:
         return self.detect_async(txns, commit_version).result()
 

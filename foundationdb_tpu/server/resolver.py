@@ -13,12 +13,13 @@ reference (ops/conflict_oracle.py). Both make identical decisions (tested).
 
 from __future__ import annotations
 
-from foundationdb_tpu.core.notified import NotifiedVersion
+from foundationdb_tpu.core.notified import AsyncTrigger, NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
 from foundationdb_tpu.ops.conflict import DeviceConflictSet
 from foundationdb_tpu.ops.conflict_oracle import OracleConflictSet
 from foundationdb_tpu.server.interfaces import (
     ResolveTransactionBatchReply, ResolveTransactionBatchRequest, Token)
+from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 
 
@@ -47,6 +48,14 @@ class Resolver:
         self.n_proxies = n_proxies
         self.version = NotifiedVersion(recovery_version)
         self.conflict_set = new_conflict_set(oldest_version=recovery_version)
+        self._pipelined = hasattr(self.conflict_set, "detect_async")
+        if self._pipelined:
+            # Force the device programs (all serving buckets) to compile
+            # NOW: a cold-cache XLA compile on the first SERVED commit would
+            # stall the pipeline for tens of seconds. Subsequent
+            # constructions (recoveries) hit the in-process jit cache;
+            # cross-process runs hit the persistent compile cache.
+            self.conflict_set.warmup()
         self._recent_replies: dict[int, ResolveTransactionBatchReply] = {}
         # retained state (metadata) transactions for other proxies' catch-up
         # (Resolver.actor.cpp:59-62,170-224): version -> [(locally_committed,
@@ -54,21 +63,118 @@ class Resolver:
         self._recent_state_txns: dict[int, list] = {}
         self._proxy_last: dict[int, int] = {}  # proxy_id -> last version
         self.total_resolved = 0
+        # Device pipelining: dispatched-but-unread batches in version order.
+        # The readback drains in GROUPS with one device sync per drain
+        # (ops/conflict.drain_handles), off the loop thread, so resolver
+        # throughput is set by dispatch rate while GRV/reads keep flowing —
+        # the serving-path analogue of the proxy's phase pipelining
+        # (MasterProxyServer.actor.cpp:364-366).
+        self._drain_pending: list = []
+        self._drain_wake = AsyncTrigger()
+        self._drained_seq = NotifiedVersion(0)  # drain-group ordering gate
+        self._drain_groups: set = set()  # in-flight readback actors
+        # set when the device state overflowed (truncated state could yield
+        # FALSE COMMITS): this resolver must stop deciding batches — every
+        # reply is an error until a recovery replaces it with a fresh
+        # conflict set (clearConflictSet semantics, SkipList.cpp:957)
+        self._poisoned: FDBError | None = None
+        self._drain_task = (process.spawn(self._drain_loop(), "resolverDrain")
+                            if self._pipelined else None)
         process.register(Token.RESOLVER_RESOLVE, self._on_resolve)
+
+    def shutdown(self):
+        """Displaced by a re-created resolver on the same worker."""
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+        for t in list(self._drain_groups):
+            t.cancel()
 
     def _on_resolve(self, req: ResolveTransactionBatchRequest, reply):
         self.process.spawn(self._resolve_batch(req, reply), "resolveBatch")
 
     async def _resolve_batch(self, req: ResolveTransactionBatchRequest, reply):
         await self.version.when_at_least(req.prev_version)
+        if self._poisoned is not None:
+            reply.send_error(self._poisoned)
+            return
         if req.version <= self.version.get():
             cached = self._recent_replies.get(req.version)
             if cached is not None:
                 reply.send(cached)
             # unknown old version: a retransmit from before our recovery —
-            # drop; the proxy's own retry/recovery handles it
+            # drop (the reply may still be draining); the proxy retries and
+            # finds the cached reply once the drain lands
             return
-        statuses = self.conflict_set.detect(req.transactions, req.version)
+        cs = self.conflict_set
+        if self._pipelined:
+            # Enqueue transfer+compute now — device state is updated at
+            # dispatch in version order, so the NEXT batch may dispatch as
+            # soon as version advances; the verdict readback happens in the
+            # drain loop without ever blocking dispatch.
+            handle = cs.detect_async(req.transactions, req.version)
+            self.version.set(req.version)
+            self._drain_pending.append((req, reply, handle))
+            self._drain_wake.trigger()
+            return
+        statuses = cs.detect(req.transactions, req.version)
+        self.version.set(req.version)
+        self._finish_batch(req, reply, statuses)
+
+    async def _drain_loop(self):
+        """Group dispatched batches and spawn one overlapped readback actor
+        per group: group k+1's device→host copies fly while group k's are
+        still in flight (readbacks overlap on the wire), and the sequence
+        gate keeps _finish_batch strictly in dispatch order."""
+        seq = 0
+        while True:
+            if not self._drain_pending:
+                await self._drain_wake.on_trigger()
+                continue
+            entries, self._drain_pending = self._drain_pending, []
+            seq += 1
+            t = self.process.spawn(self._drain_group(seq, entries),
+                                   f"resolverDrain{seq}")
+            self._drain_groups.add(t)
+            t.add_system_callback(lambda _f, t=t: self._drain_groups.discard(t))
+
+    async def _drain_group(self, seq: int, entries: list):
+        from foundationdb_tpu.ops.conflict import drain_handles
+        loop = self.process.net.loop
+        handles = [h for _req, _reply, h in entries]
+        err = None
+        try:
+            await loop.run_blocking(lambda hs=handles: drain_handles(hs))
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise  # killed/displaced mid-drain: die, don't reply
+            err = e
+        except BaseException as e:  # noqa: BLE001 — fail the whole group
+            err = FDBError("internal_error", str(e))
+        await self._drained_seq.when_at_least(seq - 1)
+        try:
+            for req, reply, handle in entries:
+                if err is None:
+                    try:
+                        statuses = handle.result()
+                    except FDBError as e:  # state overflow: fatal
+                        err = e
+                if err is not None:
+                    # a truncated state can yield FALSE COMMITS: poison the
+                    # resolver so every later (already-dispatched or new)
+                    # batch errors too; the proxy's pipeline failure then
+                    # drives a recovery that builds a fresh conflict set
+                    self._poisoned = err
+                    reply.send_error(err)
+                    continue
+                self._finish_batch(req, reply, statuses)
+        finally:
+            self._drained_seq.set(seq)
+
+    def _finish_batch(self, req: ResolveTransactionBatchRequest, reply,
+                      statuses: list[int]):
+        """Statuses-dependent bookkeeping + reply, strictly in version order
+        (drain preserves dispatch order, so batch N's state txns are always
+        recorded before batch N+1 assembles its catch-up window)."""
         self.total_resolved += len(req.transactions)
 
         # record this batch's state txns with the LOCAL verdict; proxies AND
@@ -107,5 +213,4 @@ class Resolver:
         floor = req.version - KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
         for v in [v for v in self._recent_replies if v < floor]:
             del self._recent_replies[v]
-        self.version.set(req.version)
         reply.send(r)

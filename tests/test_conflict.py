@@ -404,3 +404,25 @@ def test_narrow_engine_long_key_collapse_is_conservative():
     # must conservatively conflict (never false-commit)
     s = dev.detect([txn(50, reads=[(long_b, long_b + b"\x00")])], 200)
     assert s == [CONFLICT]
+
+
+def test_rebase_preserves_conflicts_and_rejects_saturated_snapshots():
+    """Rebase correctness at the extremes: after a >2^29 version jump the
+    engine still catches a conflict whose versions were shifted (offsets
+    stay exact), and a snapshot so stale its offset would SATURATE at the
+    NEG sentinel is REJECTED (TOO_OLD) — a saturated snapshot compares
+    equal to 'no version' and would silently miss every conflict in the
+    window (hardened by the round-5 verify drive)."""
+    dev = small_device_set()
+    assert dev.detect([txn(0, writes=[(b"a", b"a\x00")])], 10) == [COMMITTED]
+    # one-rebase jump: offsets shift but stay representable -> exact verdict
+    s = dev.detect([txn(5, reads=[(b"a", b"a\x00")],
+                        writes=[(b"b", b"b\x00")])], (1 << 30) + 77)
+    assert s == [CONFLICT], s
+    # two-rebase jump: snapshot 5's offset falls below NEG -> conservative
+    # rejection, never a false commit
+    dev2 = small_device_set()
+    assert dev2.detect([txn(0, writes=[(b"a", b"a\x00")])], 10) == [COMMITTED]
+    s = dev2.detect([txn(5, reads=[(b"a", b"a\x00")],
+                         writes=[(b"b", b"b\x00")])], 1 << 31)
+    assert s == [TOO_OLD], s
