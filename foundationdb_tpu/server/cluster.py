@@ -131,7 +131,13 @@ class RecoverableCluster:
     def __init__(self, seed: int = 0, n_coordinators: int = 3,
                  n_workers: int = 5, n_proxies: int = 2, n_resolvers: int = 1,
                  n_tlogs: int = 2, n_storage: int = 2, n_replicas: int = 1,
-                 n_storage_workers: int | None = None):
+                 n_storage_workers: int | None = None,
+                 region_dcs: tuple | None = None,
+                 satellite_dc: str | None = None, n_satellites: int = 0,
+                 usable_regions: int = 1, n_log_routers: int = 1,
+                 worker_dcs: list[str] | None = None,
+                 storage_worker_dcs: list[str] | None = None,
+                 coord_dcs: list[str] | None = None):
         from foundationdb_tpu.server.clustercontroller import (
             ClusterConfig, ClusterController)
         from foundationdb_tpu.server.coordination import Coordinator, elect_leader
@@ -143,11 +149,21 @@ class RecoverableCluster:
         self.config = ClusterConfig(n_proxies=n_proxies,
                                     n_resolvers=n_resolvers,
                                     n_tlogs=n_tlogs, n_storage=n_storage,
-                                    n_replicas=n_replicas)
+                                    n_replicas=n_replicas,
+                                    region_dcs=region_dcs,
+                                    satellite_dc=satellite_dc,
+                                    n_satellites=n_satellites,
+                                    usable_regions=usable_regions,
+                                    n_log_routers=n_log_routers)
         if n_storage_workers is None:
-            n_storage_workers = n_storage * n_replicas
+            n_storage_workers = n_storage * n_replicas * max(
+                1, usable_regions if region_dcs else 1)
 
-        self.coord_procs = [self.net.new_process(f"coord:{i}")
+        def dc_at(dcs, i):
+            return dcs[i] if dcs and i < len(dcs) else "dc0"
+
+        self.coord_procs = [self.net.new_process(f"coord:{i}",
+                                                 dc_id=dc_at(coord_dcs, i))
                             for i in range(n_coordinators)]
         self.coordinators = [p.address for p in self.coord_procs]
         self.coords = [Coordinator(p) for p in self.coord_procs]
@@ -161,10 +177,13 @@ class RecoverableCluster:
         # servers (the only roles with irreplaceable single-replica state
         # until replication lands) get dedicated workers, so killing a txn
         # role never destroys a shard
-        self.worker_procs = [self.net.new_process(f"worker:{i}")
+        self.worker_procs = [self.net.new_process(f"worker:{i}",
+                                                  dc_id=dc_at(worker_dcs, i))
                              for i in range(n_workers)]
-        self.storage_worker_procs = [self.net.new_process(f"storagew:{i}")
-                                     for i in range(n_storage_workers)]
+        self.storage_worker_procs = [
+            self.net.new_process(f"storagew:{i}",
+                                 dc_id=dc_at(storage_worker_dcs, i))
+            for i in range(n_storage_workers)]
 
         def start_worker(proc: SimProcess, process_class: str = "unset"):
             proc.worker = Worker(proc, self.coordinators,
@@ -192,6 +211,35 @@ class RecoverableCluster:
         for p in self.storage_worker_procs:
             p.boot_fn = start_storage_worker
             start_storage_worker(p)
+
+    @classmethod
+    def two_region(cls, seed: int = 0, n_storage: int = 1,
+                   n_replicas: int = 1, **kw) -> "RecoverableCluster":
+        """The canonical dual-region layout (the reference's region config,
+        configuration.rst "Configuring regions"): dc0 = primary (txn
+        subsystem + storage replicas), sat0 = satellite log (synchronously
+        in every commit quorum, so a whole-dc0 loss loses no acked commit),
+        dc1 = standby region (full storage replica set fed through log
+        routers, failover target). Coordinators 1/1/1 so losing any one
+        region keeps a majority."""
+        nsw = n_storage * n_replicas
+        return cls(
+            seed=seed, n_coordinators=3,
+            coord_dcs=["dc0", "sat0", "dc1"],
+            n_workers=6,
+            worker_dcs=["dc0", "dc0", "dc0", "sat0", "dc1", "dc1"],
+            n_proxies=1, n_resolvers=1, n_tlogs=1,
+            n_storage=n_storage, n_replicas=n_replicas,
+            n_storage_workers=2 * nsw,
+            storage_worker_dcs=["dc0"] * nsw + ["dc1"] * nsw,
+            region_dcs=("dc0", "dc1"), satellite_dc="sat0", n_satellites=1,
+            usable_regions=2, n_log_routers=1, **kw)
+
+    def kill_dc(self, dc_id: str):
+        """Region loss: kill every process whose locality is in `dc_id`."""
+        for p in list(self.net.processes.values()):
+            if p.dc_id == dc_id and p.alive:
+                self.net.kill(p.address)
 
     def database(self, name: str = "client:0") -> Database:
         proc = self.net.processes.get(name) or self.net.new_process(name)

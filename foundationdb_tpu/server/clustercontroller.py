@@ -47,6 +47,20 @@ class ClusterConfig:
     n_tlogs: int = 1
     n_storage: int = 1  # number of SHARDS
     n_replicas: int = 1  # storage team size per shard (replication factor)
+    # -- two-region (the reference's region configuration,
+    # DatabaseConfiguration.h regions + TagPartitionedLogSystem satellite
+    # log sets + LogRouter.actor.cpp) --
+    # region_dcs: dc ids in failover-priority order; recovery recruits the
+    # txn subsystem in the first listed dc with enough live workers, so
+    # killing the whole primary region fails over to the next.
+    region_dcs: tuple | None = None
+    satellite_dc: str | None = None  # hosts the synchronous satellite logs
+    n_satellites: int = 0
+    # usable_regions=2: the standby region keeps full storage replicas fed
+    # asynchronously through log routers (its tags still route through the
+    # primary log system; the routers pull each tag across the WAN once)
+    usable_regions: int = 1
+    n_log_routers: int = 1
 
 
 # ProcessClass fitness per role (fdbrpc/Locality.h ProcessClass::machineClassFitness,
@@ -349,10 +363,33 @@ class ClusterController:
         # exclusion list is mirrored into the cstate since the database is
         # unreadable during recovery
         excluded = set(((prior or {}).get("conf") or {}).get("excluded") or [])
-        stateless = [a for a in self.registry.alive("stateless", now)
-                     if a not in excluded]
-        log_workers = [a for a in self.registry.alive("tlog", now)
-                       if a not in excluded]
+        stateless_all = [a for a in self.registry.alive("stateless", now)
+                         if a not in excluded]
+        log_workers_all = [a for a in self.registry.alive("tlog", now)
+                           if a not in excluded]
+
+        def dc_of(a: str) -> str:
+            return self.registry.locality_of(a).dc_id
+
+        # region selection: the first dc in priority order with enough live
+        # workers hosts the txn subsystem — so a dead primary REGION makes
+        # recovery recruit in the next region (the failover path the
+        # reference drives through its region priority config)
+        primary_dc = None
+        if cfg.region_dcs:
+            for dc in cfg.region_dcs:
+                sl = [a for a in stateless_all if dc_of(a) == dc]
+                lw = [a for a in log_workers_all if dc_of(a) == dc]
+                if (len(sl) >= max(1, cfg.n_proxies, cfg.n_resolvers)
+                        and len(lw) >= cfg.n_tlogs):
+                    primary_dc = dc
+                    stateless, log_workers = sl, lw
+                    break
+            if primary_dc is None:
+                raise FDBError("recruitment_failed",
+                               "no region has enough workers")
+        else:
+            stateless, log_workers = stateless_all, log_workers_all
         # one resolver/proxy per worker: co-locating two same-keyed roles on
         # one process would silently displace the first (single endpoint
         # token per role kind per process)
@@ -369,9 +406,34 @@ class ClusterController:
         tlog_addrs = await self._recruit_many(
             log_workers, cfg.n_tlogs, "tlog",
             lambda i: {"uid": uids[i], "recovery_version": start_version})
+        # satellite log set: synchronously quorumed OUTSIDE the primary dc
+        # (TagPartitionedLogSystem satellite tLogs), so losing the whole
+        # primary region loses no acked commit. Folded into the epoch's
+        # addr list after the n_primary split: peeks/pops/locks treat every
+        # member uniformly, only the proxy's push quorum is per set.
+        sat_addrs: list[str] = []
+        sat_uids: list[str] = []
+        if cfg.region_dcs and cfg.n_satellites:
+            if KNOBS.TLOG_QUORUM_ANTIQUORUM:
+                raise FDBError("recruitment_failed",
+                               "satellite logs require antiquorum 0")
+            sat_workers = [a for a in log_workers_all
+                           if dc_of(a) == cfg.satellite_dc]
+            if len(sat_workers) < cfg.n_satellites:
+                raise FDBError("recruitment_failed",
+                               "not enough satellite log workers")
+            sat_uids = [f"e{epoch}-{self.process.address}"
+                        f"-a{self._attempt}-s{i}"
+                        for i in range(cfg.n_satellites)]
+            sat_addrs = await self._recruit_many(
+                sat_workers, cfg.n_satellites, "tlog",
+                lambda i: {"uid": sat_uids[i],
+                           "recovery_version": start_version})
         new_epochs = old_epochs + [LogEpoch(begin=recovery_version, end=None,
-                                            addrs=tlog_addrs, epoch=epoch,
-                                            uids=uids)]
+                                            addrs=tlog_addrs + sat_addrs,
+                                            epoch=epoch,
+                                            uids=uids + sat_uids,
+                                            n_primary=len(tlog_addrs))]
 
         resolver_addrs = await self._recruit_many(
             stateless, cfg.n_resolvers, "resolver",
@@ -382,9 +444,16 @@ class ClusterController:
             lambda i: {"recovery_version": start_version, "epoch": epoch,
                        "coordinators": list(self.coordinators)}))[0]
 
+        remote_dc = None
+        if cfg.region_dcs and cfg.usable_regions >= 2:
+            remotes = [d for d in cfg.region_dcs if d != primary_dc]
+            remote_dc = remotes[0] if remotes else None
         if prior is None:
             storage_workers = [a for a in self.registry.alive("storage", now)
                                if a not in excluded]
+            if primary_dc is not None:
+                storage_workers = [a for a in storage_workers
+                                   if dc_of(a) == primary_dc]
             # one storage role per worker (a process has one set of STORAGE_*
             # endpoints, so co-located roles would displace each other —
             # also the reference's normal deployment shape)
@@ -443,9 +512,64 @@ class ClusterController:
                     team.append(tag)
                 pool = [a for a in pool if a not in picked]
                 shard_tags.append(team)
+            router_of: dict[int, tuple[str, str]] = {}
+            if remote_dc is not None:
+                # remote-region replica set (usable_regions=2): every shard
+                # gets n_replicas more storages in the standby region with
+                # their OWN tags — mutations route to those tags through
+                # the primary log system, and the region's log routers pull
+                # each tag across the WAN once to feed them
+                remote_pool = [a for a in self.registry.alive("storage", now)
+                               if a not in excluded and dc_of(a) == remote_dc]
+                if len(remote_pool) < cfg.n_storage * cfg.n_replicas:
+                    raise FDBError("recruitment_failed",
+                                   "not enough remote-region storage workers")
+                base = cfg.n_storage * cfg.n_replicas
+                remote_tags_all = [base + i * cfg.n_replicas + r
+                                   for i in range(cfg.n_storage)
+                                   for r in range(cfg.n_replicas)]
+                router_of = await self._recruit_log_routers(
+                    remote_dc, remote_tags_all, new_epochs,
+                    recovery_version, epoch, excluded, now)
+                rp = list(remote_pool)
+                for i in range(cfg.n_storage):
+                    srange = (boundaries[i],
+                              boundaries[i + 1] if i + 1 < len(boundaries)
+                              else None)
+                    for r in range(cfg.n_replicas):
+                        tag = base + i * cfg.n_replicas + r
+                        w = rp.pop(0)
+                        ep_view = self._router_epochs(new_epochs, router_of,
+                                                      tag)
+                        addr = (await self._recruit_many(
+                            [w], 1, "storage",
+                            lambda _i, tag=tag, srange=srange,
+                            ep_view=ep_view: {
+                                "tag": tag, "log_epochs": ep_view,
+                                "recovery_count": epoch,
+                                "shard_ranges": [srange]}))[0]
+                        storages.append((addr, tag))
+                        shard_tags[i].append(tag)
         else:
             shard_tags = list(prior.get("shard_tags")
                               or [[t] for _a, t in storages])
+            # refresh the standby region's log routers for the new
+            # generation (they pull the NEW epoch list); best effort — with
+            # the remote region's workers gone (or after a failover into
+            # it) its storages just bind the primary view directly
+            router_of = {}
+            if remote_dc is not None:
+                remote_tags_all = sorted(
+                    t for a, t in storages if dc_of(a) == remote_dc)
+                if remote_tags_all:
+                    try:
+                        router_of = await self._recruit_log_routers(
+                            remote_dc, remote_tags_all, new_epochs,
+                            recovery_version, epoch, excluded, now)
+                    except FDBError as e:
+                        if e.name == "operation_cancelled":
+                            raise
+                        router_of = {}
 
         # admission control alongside the new generation (Ratekeeper runs
         # with the master in the reference)
@@ -478,6 +602,9 @@ class ClusterController:
                     "resolvers": resolver_map,
                     "tlogs": [Endpoint(a, Token.TLOG_COMMIT) for a in tlog_addrs],
                     "tlog_uids": list(uids),
+                    "satellites": [Endpoint(a, Token.TLOG_COMMIT)
+                                   for a in sat_addrs],
+                    "satellite_uids": list(sat_uids),
                     "system_snapshot": list(system_snapshot),
                     "storages": list(storages),
                     "recovery_version": start_version,
@@ -505,10 +632,13 @@ class ClusterController:
         self._cstate_conf = (prior.get("conf") if prior else None) or {}
 
         # ---- ACCEPTING_COMMITS: rebind storages, publish DBInfo ----
-        for addr, _tag in storages:
+        for addr, tag in storages:
+            # standby-region storages bind the open generation via their
+            # tag's log router; everyone else binds the primary view
+            eps = self._router_epochs(new_epochs, router_of, tag)
             self.net.one_way(self.process,
                              Endpoint(addr, Token.STORAGE_SET_LOGSYSTEM),
-                             SetLogSystemRequest(epochs=list(new_epochs),
+                             SetLogSystemRequest(epochs=eps,
                                                  rollback_to=recovery_version,
                                                  recovery_count=epoch))
         if prior is not None:
@@ -582,12 +712,62 @@ class ClusterController:
             self._watchers.append(self.process.spawn(
                 self._watch_epoch_role(pa, Token.PROXY_PING, epoch, "proxy"),
                 "watchProxy"))
+        router_addrs = sorted({a for a, _u in router_of.values()})
         for addr in sorted(set([master_addr] + proxy_addrs + resolver_addrs
-                               + tlog_addrs + [rk_addr])):
+                               + tlog_addrs + sat_addrs + router_addrs
+                               + [rk_addr])):
             self._watchers.append(self.process.spawn(
                 self._watch_role(addr, "txn",
                                  self._incarnations.get(addr, 0)),
                 "watchRole"))
+
+    async def _recruit_log_routers(self, remote_dc: str, tags: list[int],
+                                   epochs: list[LogEpoch], begin: int,
+                                   epoch: int, excluded: set,
+                                   now: float) -> dict:
+        """Recruit the standby region's log routers (LogRouter.actor.cpp):
+        tags are partitioned round-robin over n_log_routers routers hosted
+        on the region's tlog-capable workers; each router pulls its tags
+        from the primary log system once and re-serves them locally.
+        Returns {tag: (router_addr, router_uid)}."""
+        cfg = self.config
+        workers = [a for a in self.registry.alive("tlog", now)
+                   if a not in excluded
+                   and self.registry.locality_of(a).dc_id == remote_dc]
+        if not workers:
+            raise FDBError("recruitment_failed",
+                           "no remote-region log-router workers")
+        n = max(1, min(cfg.n_log_routers, len(workers)))
+        router_of: dict[int, tuple[str, str]] = {}
+        for j in range(n):
+            uid = (f"e{epoch}-{self.process.address}"
+                   f"-a{self._attempt}-lr{j}")
+            tags_j = [t for k, t in enumerate(tags) if k % n == j]
+            if not tags_j:
+                continue
+            addr = (await self._recruit_many(
+                [workers[j % len(workers)]], 1, "logrouter",
+                lambda _i, uid=uid, tags_j=tags_j: {
+                    "uid": uid, "tags": tags_j,
+                    "epochs": list(epochs), "begin": begin}))[0]
+            for t in tags_j:
+                router_of[t] = (addr, uid)
+        return router_of
+
+    @staticmethod
+    def _router_epochs(epochs: list[LogEpoch], router_of: dict,
+                       tag: int) -> list[LogEpoch]:
+        """A remote storage's epoch view: the OPEN generation routes through
+        the tag's log router; closed generations stay direct (their data is
+        already applied locally or reachable with peek failover — including
+        the satellite members folded into each epoch's addr list)."""
+        if tag not in router_of:
+            return list(epochs)
+        addr, uid = router_of[tag]
+        last = epochs[-1]
+        return list(epochs[:-1]) + [LogEpoch(
+            begin=last.begin, end=last.end, addrs=[addr], epoch=last.epoch,
+            uids=[uid], n_primary=1)]
 
     async def _lock_old_generation(self, old: LogEpoch) -> int:
         """epochEnd (TagPartitionedLogSystem:398-417): lock enough old TLogs
@@ -842,7 +1022,9 @@ class ClusterController:
             stateless_addrs = ([info.master] + list(info.proxies)
                                + list(info.resolvers)
                                + ([info.ratekeeper] if info.ratekeeper else []))
-            tlog_addrs = (info.log_epochs[-1].addrs if info.log_epochs else [])
+            last_ep = info.log_epochs[-1] if info.log_epochs else None
+            tlog_addrs = (last_ep.addrs[:last_ep.n_primary or len(last_ep.addrs)]
+                          if last_ep else [])
             cur = (current_cost(stateless_addrs, "stateless")
                    + current_cost(tlog_addrs, "tlog"))
             b_s = best_cost("stateless", [1, len(info.proxies),
@@ -887,7 +1069,9 @@ class ClusterController:
         shape = {}
         cur = {"n_proxies": len(info.proxies),
                "n_resolvers": len(info.resolvers),
-               "n_tlogs": len(info.log_epochs[-1].addrs)
+               "n_tlogs": len(info.log_epochs[-1].addrs[
+                   :info.log_epochs[-1].n_primary
+                   or len(info.log_epochs[-1].addrs)])
                if info.log_epochs else 0}
         for k in ("n_proxies", "n_resolvers", "n_tlogs"):
             if k in conf and conf[k] != cur[k]:

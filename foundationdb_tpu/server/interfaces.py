@@ -316,6 +316,13 @@ class LogEpoch:
     addrs: list[str]
     epoch: int = 0
     uids: list[str] | None = None  # None -> [""] per addr (direct clusters)
+    # two-region: the first n_primary addrs are the primary-region TLogs,
+    # the rest are SATELLITE TLogs (synchronously quorumed outside the
+    # primary DC, TagPartitionedLogSystem's satellite log set). Peeks, pops
+    # and locks treat them uniformly — every member holds every tag — but
+    # the proxy's push quorum is per set, rebuilt from this split. None =
+    # single-region epoch (all addrs primary).
+    n_primary: int | None = None
 
     def uid_of(self, i: int) -> str:
         return self.uids[i] if self.uids else ""

@@ -263,11 +263,14 @@ class TLogHost:
 
     def __init__(self, process: SimProcess):
         self.process = process
-        self.generations: dict[str, TLog] = {}  # uid -> instance
-        process.register(Token.TLOG_COMMIT, self._route(TLog._on_commit))
-        process.register(Token.TLOG_PEEK, self._route(TLog._on_peek))
-        process.register(Token.TLOG_POP, self._route(TLog._on_pop))
-        process.register(Token.TLOG_LOCK, self._route(TLog._on_lock))
+        # uid -> instance; a TLog generation OR a LogRouter (both answer the
+        # peek/pop surface — "log routers appear as just another peek
+        # source", logsystem.py)
+        self.generations: dict[str, object] = {}
+        process.register(Token.TLOG_COMMIT, self._route("_on_commit"))
+        process.register(Token.TLOG_PEEK, self._route("_on_peek"))
+        process.register(Token.TLOG_POP, self._route("_on_pop"))
+        process.register(Token.TLOG_LOCK, self._route("_on_lock"))
         process.register(Token.QUEUE_STATS, self._on_queue_stats)
 
     def _on_queue_stats(self, req, reply):
@@ -275,7 +278,8 @@ class TLogHost:
         # lagging consumer must register even after its backlog spilled
         from foundationdb_tpu.server.ratekeeper import QueueStatsReply
         reply.send(QueueStatsReply(queue_bytes=sum(
-            sum(t._tag_bytes.values()) for t in self.generations.values())))
+            sum(t._tag_bytes.values())
+            for t in self.generations.values() if isinstance(t, TLog))))
 
     def add(self, uid: str, recovery_version: int = 0) -> TLog:
         """uids are unique per recovery ATTEMPT (LogSystemConfig's TLog UIDs),
@@ -287,12 +291,12 @@ class TLogHost:
         self.generations[uid] = t
         return t
 
-    def _route(self, method):
+    def _route(self, name: str):
         def handler(req, reply):
             t = self.generations.get(req.uid)
             if t is None:
                 reply.send_error(FDBError("tlog_stopped",
                                           f"no generation {req.uid!r}"))
             else:
-                method(t, req, reply)
+                getattr(t, name)(req, reply)
         return handler

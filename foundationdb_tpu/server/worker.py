@@ -101,6 +101,18 @@ class Worker:
                 host = self.roles["tloghost"] = TLogHost(self.process)
             host.add(uid=args["uid"],
                      recovery_version=args.get("recovery_version", 0))
+        elif role == "logrouter":
+            from foundationdb_tpu.server.logrouter import LogRouter
+            from foundationdb_tpu.server.tlog import TLogHost
+            host = self.roles.get("tloghost")
+            if host is None:
+                host = self.roles["tloghost"] = TLogHost(self.process)
+            old = host.generations.get(args["uid"])
+            if old is not None and hasattr(old, "shutdown"):
+                old.shutdown()
+            host.generations[args["uid"]] = LogRouter(
+                self.process, uid=args["uid"], tags=args["tags"],
+                epochs=args["epochs"], begin=args.get("begin", 0))
         elif role == "storage":
             from foundationdb_tpu.server.storage import StorageServer
             self._set_role(f"storage:{args['tag']}",

@@ -112,6 +112,9 @@ KNOBS.init("BUGGIFY_ENABLED", False)
 KNOBS.init("TLOG_QUORUM_ANTIQUORUM", 0)
 KNOBS.init("TLOG_PEEK_REPLY_BYTES", 150_000, (10_000,))  # bounded peek pages
 KNOBS.init("TLOG_SPILL_BYTES", 1_500_000, (100_000,))  # in-memory cap per log
+# log-router pull-ahead bound, in versions past the slowest consumer's pop
+# (LogRouter.actor.cpp bounds by bytes via LOG_ROUTER_MAX_SEARCH_MEMORY)
+KNOBS.init("LOG_ROUTER_BUFFER_VERSIONS", 50_000_000)
 
 # --- Ratekeeper (fdbserver/Ratekeeper.actor.cpp updateRate :250) ---
 KNOBS.init("RK_UPDATE_INTERVAL", 0.5)
