@@ -1,3 +1,5 @@
 from foundationdb_tpu.testing.workloads import (  # noqa: F401
-    AttritionWorkload, ConsistencyCheckWorkload, CycleWorkload,
-    RandomCloggingWorkload, SwizzleCloggingWorkload, run_spec)
+    ApiCorrectnessWorkload, AtomicOpsWorkload, AttritionWorkload,
+    ConflictRangeWorkload, ConsistencyCheckWorkload, CycleWorkload,
+    RandomCloggingWorkload, RandomMoveKeysWorkload, SwizzleCloggingWorkload,
+    WriteDuringReadWorkload, run_spec)

@@ -46,6 +46,33 @@ class Workload:
     def _time_left(self) -> bool:
         return self.cluster.loop.now() < self.stop_at
 
+    async def _commit_resolved(self, db, fn, marker, token):
+        """Run fn+commit manually; resolve commit_unknown_result through the
+        marker so the model only advances for transactions that landed."""
+        for _ in range(200):
+            tr = db.create_transaction()
+            try:
+                overlay = await fn(tr)
+                await tr.commit()
+                return overlay
+            except FDBError as e:
+                if e.name == "commit_unknown_result":
+                    async def probe(t):
+                        return await t.get(marker)
+                    if await db.transact(probe, max_retries=500) == token:
+                        return overlay
+                    continue
+                if e.name in ("not_committed", "transaction_too_old",
+                              "future_version", "timed_out",
+                              "proxies_changed", "cluster_not_fully_recovered",
+                              "operation_failed", "wrong_shard_server",
+                              "request_maybe_delivered", "broken_promise"):
+                    await self.cluster.loop.delay(
+                        0.2 * (0.5 + self.rng.random()))
+                    continue
+                raise
+        return None
+
 
 class CycleWorkload(Workload):
     """N keys form a ring by value; transactional 3-key rotations preserve
@@ -338,3 +365,412 @@ class ConsistencyCheckWorkload(Workload):
                 assert rows == first_rows, \
                     (f"shard {i}: replica tag {tag} diverges from tag "
                      f"{first_tag}: {len(rows)} vs {len(first_rows)} rows")
+
+
+class ConflictRangeWorkload(Workload):
+    """System-level RESOLVER ORACLE (fdbserver/workloads/ConflictRange.actor.cpp):
+    transaction A reads a random range; transaction B then commits
+    writes/clears at random keys; A commits a write of its own. A's outcome
+    is forced: not_committed iff B touched A's read range, committed
+    otherwise. Every verdict cross-checks the whole conflict pipeline —
+    client conflict-range registration, proxy range splitting, and the
+    device/sharded/oracle engine's decision — against an independent
+    host-side expectation."""
+
+    name = "ConflictRange"
+
+    def __init__(self, n_keys: int = 40, prefix: bytes = b"cr/"):
+        self.n = n_keys
+        self.prefix = prefix
+        self.checked = 0
+        self.conflicts = 0
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%04d" % i
+
+    async def setup(self, db):
+        async def fn(tr):
+            for i in range(0, self.n, 2):
+                tr.set(self.key(i), b"v%04d" % i)
+        await db.transact(fn)
+
+    async def start(self, db):
+        it = 0
+        while self._time_left():
+            it += 1
+            rng = self.rng
+            lo_i = rng.randint(0, self.n - 2)
+            hi_i = rng.randint(lo_i + 1, self.n)
+            lo, hi = self.key(lo_i), self.key(hi_i)
+            # B's plan is fixed up front so its transact() retries replay
+            # the identical (idempotent) mutations
+            plan = [(rng.randint(0, self.n - 1), rng.coinflip(0.5),
+                     rng.randint(0, 1 << 30))
+                    for _ in range(rng.randint(1, 4))]
+            touches = any(lo_i <= k < hi_i for k, _s, _v in plan)
+            token = b"t%08d" % it
+            marker = self.prefix + b"__marker__"
+            trA = db.create_transaction()
+            try:
+                await trA.get_read_version()
+                await trA.get_range(lo, hi)
+
+                async def bfn(tr):
+                    for k, is_set, v in plan:
+                        if is_set:
+                            tr.set(self.key(k), b"b%08d" % v)
+                        else:
+                            tr.clear(self.key(k))
+                await db.transact(bfn, max_retries=500)
+
+                trA.set(marker, token)
+                try:
+                    await trA.commit()
+                    committed = True
+                except FDBError as e:
+                    if e.name == "not_committed":
+                        committed = False
+                    elif e.name == "commit_unknown_result":
+                        async def probe(tr):
+                            return await tr.get(marker)
+                        committed = (await db.transact(probe, max_retries=500)
+                                     == token)
+                    else:
+                        continue  # infrastructure noise: no verdict
+            except FDBError:
+                continue  # clog/recovery noise: no verdict
+            assert committed == (not touches), \
+                (f"resolver verdict wrong: B touched A's range={touches}, "
+                 f"A committed={committed} (iter {it}, range "
+                 f"[{lo_i},{hi_i}), plan {plan})")
+            self.checked += 1
+            self.conflicts += 0 if committed else 1
+
+    async def check(self, db):
+        assert self.checked > 0, "no conflict-range verdicts were checked"
+        assert self.conflicts > 0, \
+            "workload never produced a conflict (coverage bug)"
+
+
+class ApiCorrectnessWorkload(Workload):
+    """Model-based API conformance (workloads/ApiCorrectness.actor.cpp):
+    a single writer drives random set/clear/clear_range/atomic-add ops plus
+    get/get_range/get_key reads, mirroring every committed mutation into a
+    host dict; every read must match the model exactly. Composable with
+    clogging: commit_unknown_result is resolved through a per-transaction
+    marker before the model advances."""
+
+    name = "ApiCorrectness"
+
+    def __init__(self, n_keys: int = 60, prefix: bytes = b"api/"):
+        self.n = n_keys
+        self.prefix = prefix
+        self.model: dict[bytes, bytes] = {}
+        self.txns = 0
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%04d" % i
+
+    def _apply(self, model, ops):
+        from foundationdb_tpu.utils.types import MutationType, apply_atomic_op
+        for op in ops:
+            kind = op[0]
+            if kind == "set":
+                model[op[1]] = op[2]
+            elif kind == "clear":
+                model.pop(op[1], None)
+            elif kind == "clear_range":
+                for k in [k for k in model if op[1] <= k < op[2]]:
+                    del model[k]
+            elif kind == "add":
+                model[op[1]] = apply_atomic_op(
+                    MutationType.ADD_VALUE, model.get(op[1]), op[2])
+
+    async def start(self, db):
+        from foundationdb_tpu.server.interfaces import KeySelector
+        from foundationdb_tpu.utils.types import MutationType
+        it = 0
+        while self._time_left():
+            it += 1
+            rng = self.rng
+            ops = []
+            for _ in range(rng.randint(1, 6)):
+                r = rng.random()
+                k = self.key(rng.randint(0, self.n - 1))
+                if r < 0.45:
+                    ops.append(("set", k, b"v%06d" % rng.randint(0, 1 << 20)))
+                elif r < 0.6:
+                    ops.append(("clear", k))
+                elif r < 0.75:
+                    i = rng.randint(0, self.n - 2)
+                    j = rng.randint(i + 1, self.n)
+                    ops.append(("clear_range", self.key(i), self.key(j)))
+                else:
+                    ops.append(("add", k,
+                                rng.randint(1, 1000).to_bytes(8, "little")))
+            marker = self.prefix + b"__marker__"
+            token = b"t%08d" % it
+
+            async def fn(tr, ops=ops, token=token):
+                overlay = dict(self.model)
+                self._apply(overlay, ops)
+                for op in ops:
+                    if op[0] == "set":
+                        tr.set(op[1], op[2])
+                    elif op[0] == "clear":
+                        tr.clear(op[1])
+                    elif op[0] == "clear_range":
+                        tr.clear_range(op[1], op[2])
+                    else:
+                        tr.atomic_op(MutationType.ADD_VALUE, op[1], op[2])
+                # reads through the RYW overlay must equal the model
+                for _ in range(2):
+                    k = self.key(self.rng.randint(0, self.n - 1))
+                    got = await tr.get(k)
+                    want = overlay.get(k)
+                    assert got == want, f"get({k}) = {got}, model {want}"
+                i = self.rng.randint(0, self.n - 2)
+                j = self.rng.randint(i + 1, self.n)
+                rows = await tr.get_range(self.key(i), self.key(j))
+                want_rows = sorted((k, v) for k, v in overlay.items()
+                                   if self.key(i) <= k < self.key(j)
+                                   and not k.endswith(b"__marker__"))
+                got_rows = [(k, v) for k, v in rows
+                            if not k.endswith(b"__marker__")]
+                assert got_rows == want_rows, \
+                    f"get_range[{i},{j}) diverges from model"
+                # selector read: first key at-or-after a random point
+                k = self.key(self.rng.randint(0, self.n - 1))
+                got_k = await tr.get_key(KeySelector.first_greater_or_equal(k))
+                cand = sorted(kk for kk in overlay if kk >= k)
+                if cand and cand[0] < self.prefix + b"\xff":
+                    assert got_k == cand[0], \
+                        f"get_key(>={k}) = {got_k}, model {cand[0]}"
+                tr.set(marker, token)
+                return overlay
+
+            try:
+                overlay = await self._commit_resolved(db, fn, marker, token)
+            except FDBError:
+                continue  # infrastructure noise; model unchanged
+            if overlay is not None:
+                overlay.pop(marker, None)
+                self.model = overlay
+                self.txns += 1
+
+    async def check(self, db):
+        assert self.txns > 0, "no API transactions committed"
+        async def read_all(tr):
+            return await tr.get_range(self.prefix, self.prefix + b"\xff",
+                                      limit=10_000)
+        rows = await db.transact(read_all, max_retries=1000)
+        got = {k: v for k, v in rows if not k.endswith(b"__marker__")}
+        want = dict(self.model)
+        assert got == want, \
+            (f"final state diverges from model: {len(got)} vs {len(want)} "
+             f"rows after {self.txns} txns")
+
+
+class WriteDuringReadWorkload(Workload):
+    """RYW-overlay conformance under interleaved reads and writes INSIDE one
+    transaction (workloads/WriteDuringRead.actor.cpp): after every mutation,
+    plain and snapshot reads must both see the overlay state (snapshot reads
+    skip conflict registration, not the overlay); aborted transactions must
+    leave no trace."""
+
+    name = "WriteDuringRead"
+
+    def __init__(self, n_keys: int = 30, prefix: bytes = b"wdr/"):
+        self.n = n_keys
+        self.prefix = prefix
+        self.model: dict[bytes, bytes] = {}
+        self.txns = 0
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%04d" % i
+
+    async def start(self, db):
+        from foundationdb_tpu.utils.types import MutationType, apply_atomic_op
+        it = 0
+        while self._time_left():
+            it += 1
+            rng = self.rng
+            commit_it = rng.coinflip(0.6)
+            marker = self.prefix + b"__marker__"
+            token = b"t%08d" % it
+            steps = rng.randint(2, 8)
+            plan = [rng.randint(0, 1 << 30) for _ in range(steps)]
+
+            async def fn(tr, plan=plan, token=token):
+                overlay = dict(self.model)
+                for step in plan:
+                    srng = step
+                    k = self.key(srng % self.n)
+                    kind = (srng >> 8) % 4
+                    if kind == 0:
+                        v = b"w%08d" % (srng % 10_000_019)
+                        tr.set(k, v)
+                        overlay[k] = v
+                    elif kind == 1:
+                        tr.clear(k)
+                        overlay.pop(k, None)
+                    elif kind == 2:
+                        d = (1 + srng % 999).to_bytes(8, "little")
+                        tr.atomic_op(MutationType.ADD_VALUE, k, d)
+                        overlay[k] = apply_atomic_op(
+                            MutationType.ADD_VALUE, overlay.get(k), d)
+                    # read-after-write, both plain and snapshot
+                    got = await tr.get(k)
+                    assert got == overlay.get(k), \
+                        f"RYW get({k}) = {got}, overlay {overlay.get(k)}"
+                    got_s = await tr.get(k, snapshot=True)
+                    assert got_s == overlay.get(k), \
+                        f"snapshot get({k}) = {got_s}, overlay {overlay.get(k)}"
+                rows = await tr.get_range(self.prefix, self.prefix + b"\xf0")
+                want = sorted((kk, vv) for kk, vv in overlay.items()
+                              if not kk.endswith(b"__marker__"))
+                got_rows = [(kk, vv) for kk, vv in rows
+                            if not kk.endswith(b"__marker__")]
+                assert got_rows == want, "RYW range diverges from overlay"
+                tr.set(marker, token)
+                return overlay
+
+            if not commit_it:
+                # run and abandon: an uncommitted transaction's writes must
+                # never become visible
+                tr = db.create_transaction()
+                try:
+                    await fn(tr)
+                except FDBError:
+                    pass
+                tr.reset()
+                continue
+            try:
+                overlay = await self._commit_resolved(db, fn, marker, token)
+            except FDBError:
+                continue
+            if overlay is not None:
+                overlay.pop(marker, None)
+                self.model = overlay
+                self.txns += 1
+
+    async def check(self, db):
+        assert self.txns > 0, "no write-during-read transactions committed"
+        async def read_all(tr):
+            return await tr.get_range(self.prefix, self.prefix + b"\xff",
+                                      limit=10_000)
+        rows = await db.transact(read_all, max_retries=1000)
+        got = {k: v for k, v in rows if not k.endswith(b"__marker__")}
+        assert got == dict(self.model), "abandoned writes leaked or state lost"
+
+
+class AtomicOpsWorkload(Workload):
+    """Atomic-op consistency under retries and faults
+    (workloads/AtomicOps.actor.cpp): every transaction atomically ADDs a
+    delta to one of K counters AND writes a VERSIONSTAMPED log row carrying
+    the same delta — the two ride one commit, so even a duplicated
+    commit_unknown_result retry keeps the invariant sum(logs) == counter."""
+
+    name = "AtomicOps"
+
+    def __init__(self, n_counters: int = 4, prefix: bytes = b"aops/"):
+        self.k = n_counters
+        self.prefix = prefix
+        self.attempted = 0
+
+    async def start(self, db):
+        from foundationdb_tpu.utils.types import MutationType
+        while self._time_left():
+            rng = self.rng
+            c = rng.randint(0, self.k - 1)
+            d = rng.randint(1, 1000)
+
+            async def fn(tr, c=c, d=d):
+                tr.atomic_op(MutationType.ADD_VALUE,
+                             self.prefix + b"sum/%02d" % c,
+                             d.to_bytes(8, "little"))
+                # log key gets the commit versionstamp: EVERY application
+                # (including a duplicated retry) produces its own row
+                body = self.prefix + b"log/%02d/" % c + b"\x00" * 10
+                key = body + (len(body) - 10).to_bytes(4, "little")
+                tr.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key,
+                             d.to_bytes(8, "little"))
+            try:
+                await db.transact(fn, max_retries=1000)
+                self.attempted += 1
+            except FDBError:
+                pass
+            await self.cluster.loop.delay(0.02 * self.rng.random())
+
+    async def check(self, db):
+        assert self.attempted > 0, "no atomic transactions ran"
+        async def read_all(tr):
+            sums = {}
+            logs = {}
+            for c in range(self.k):
+                v = await tr.get(self.prefix + b"sum/%02d" % c)
+                sums[c] = int.from_bytes(v or b"", "little")
+                rows = await tr.get_range(self.prefix + b"log/%02d/" % c,
+                                          self.prefix + b"log/%02d0" % c,
+                                          limit=100_000)
+                logs[c] = sum(int.from_bytes(v, "little") for _k, v in rows)
+            return sums, logs
+        sums, logs = await db.transact(read_all, max_retries=1000)
+        for c in range(self.k):
+            assert sums[c] == logs[c], \
+                (f"counter {c}: atomic sum {sums[c]} != logged sum "
+                 f"{logs[c]} — an atomic op was lost or half-applied")
+        assert sum(sums.values()) > 0, "no atomic op landed"
+
+
+class RandomMoveKeysWorkload(Workload):
+    """Drive shard splits/moves/merges WHILE data workloads run
+    (workloads/RandomMoveKeys.actor.cpp): correctness must survive layouts
+    changing under live traffic; the composed Cycle + ConsistencyCheck
+    workloads assert it."""
+
+    name = "RandomMoveKeys"
+
+    def __init__(self, interval: float = 3.0):
+        self.interval = interval
+        self.moves = 0
+
+    async def start(self, db):
+        loop = self.cluster.loop
+        while self._time_left():
+            await loop.delay(self.interval * (0.5 + self.rng.random()))
+            cc = self.cluster.current_cc()
+            if cc is None or not getattr(cc, "_initial_meta_done", False):
+                continue
+            info = cc.dbinfo
+            b = list(info.shard_boundaries)
+            teams = [list(t) for t in info.teams()]
+            try:
+                if len(b) > 1 and self.rng.coinflip(0.35):
+                    # merge a random same-team boundary if one exists
+                    cands = [i for i in range(len(b) - 1)
+                             if teams[i] == teams[i + 1]]
+                    if not cands:
+                        continue
+                    i = cands[self.rng.randint(0, len(cands) - 1)]
+                    await cc._merge(i)
+                else:
+                    i = self.rng.randint(0, len(b) - 1)
+                    lo = b[i]
+                    hi = b[i + 1] if i + 1 < len(b) else None
+                    async def sample(tr):
+                        return await tr.get_range(
+                            lo or b"\x00", hi or b"\xf0", limit=50)
+                    rows = await db.transact(sample, max_retries=50)
+                    if len(rows) < 2:
+                        continue
+                    split = rows[len(rows) // 2][0]
+                    if split <= lo or (hi is not None and split >= hi):
+                        continue
+                    await cc._split_and_move(i, split)
+                self.moves += 1
+            except (FDBError, AssertionError):
+                continue  # moves legitimately race recoveries/other moves
+
+    async def check(self, db):
+        assert self.moves > 0, "no shard was ever moved"
