@@ -157,19 +157,20 @@ def run_e2e() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def main():
-    # e2e FIRST (and in subprocesses): the parent must not hold the TPU yet
-    e2e = None
-    if os.environ.get("FDB_TPU_BENCH_E2E", "1") != "0":
-        e2e = run_e2e()
-
+def run_kernel(T: int, n_batches: int, chunk: int) -> dict:
+    """One timed kernel measurement at `T` txns/batch (see module doc)."""
+    global TXNS_PER_BATCH
     import jax
+    # persistent compile cache: the scan programs are large; without this
+    # every bench run pays the full XLA compile again
+    jax.config.update("jax_compilation_cache_dir", "/tmp/fdb_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from foundationdb_tpu.ops.conflict import (
         ConflictShapes, _compiled_scan, init_state)
     from foundationdb_tpu.utils.knobs import KNOBS
 
-    T = TXNS_PER_BATCH
+    TXNS_PER_BATCH = T  # _encode_batches reads it
     # strided: 1 read + 1 write per txn, the skipListTest shape — the
     # range->txn map compiles to reshapes instead of per-eval scatters
     shapes = ConflictShapes(capacity=CAPACITY, txns=T, reads=T, writes=T,
@@ -177,17 +178,17 @@ def main():
     scan = _compiled_scan(shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
     # pre-stage everything in HBM (untimed, like skipListTest's RAM test data)
-    warm_np = _encode_batches(CHUNK, seed=1, version0=WINDOW)
-    v0 = WINDOW + CHUNK * VERSION_STEP
-    main_np = _encode_batches(N_BATCHES, seed=2, version0=v0)
+    warm_np = _encode_batches(chunk, seed=1, version0=WINDOW)
+    v0 = WINDOW + chunk * VERSION_STEP
+    main_np = _encode_batches(n_batches, seed=2, version0=v0)
     warm = jax.device_put(warm_np)
     chunks = []
-    for c in range(0, N_BATCHES, CHUNK):
+    for c in range(0, n_batches, chunk):
         chunks.append(jax.device_put(
-            {k: v[c:c + CHUNK] for k, v in main_np.items()}))
+            {k: v[c:c + chunk] for k, v in main_np.items()}))
     state = init_state(shapes, oldest=0)
 
-    # warmup: compiles the fixed-CHUNK scan and fills the window with history
+    # warmup: compiles the fixed-chunk scan and fills the window with history
     state, _stat, _comm, ovf = scan(state, warm)
     assert not bool(np.asarray(ovf).any()), "state overflow during warmup"
 
@@ -202,22 +203,65 @@ def main():
 
     ovf_np = np.concatenate([np.asarray(o) for o in ovfs])
     assert not ovf_np.any(), "conflict state overflowed; CAPACITY too small"
-    total = N_BATCHES * T
+    total = n_batches * T
     committed = int(comm_np.sum())
 
     txns_per_sec = total / dt
     cpu = measure_cpu_baseline(T)
     baseline = max(cpu.get("txns_per_sec", 0.0), BASELINE_FLOOR_TXNS_PER_SEC)
-    out = {
-        "metric": "resolver_conflict_txns_per_sec",
+    return {
         "value": round(txns_per_sec, 1),
-        "unit": "txns/s",
         "vs_baseline": round(txns_per_sec / baseline, 3),
         "committed_frac": round(committed / total, 4),
-        "batches": N_BATCHES,
+        "batches": n_batches,
         "txns_per_batch": T,
         "baseline_txns_per_sec": round(baseline, 1),
         "baseline_cpu_measured": cpu,
+    }
+
+
+def run_kernel_watchdogged(T: int, n_batches: int, chunk: int,
+                           timeout: float = 1500.0) -> dict:
+    """run_kernel in a SUBPROCESS with a deadline, falling back to the CPU
+    backend on failure: a wedged remote accelerator runtime (or a hung
+    attach) must degrade the measurement, never hang or sink the bench."""
+    import subprocess
+    import sys
+    script = os.path.abspath(__file__)
+    for env_extra, label in (({}, "default"), ({"JAX_PLATFORMS": "cpu"},
+                                               "cpu-fallback")):
+        env = dict(os.environ, **env_extra)
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, "--kernel", str(T),
+                 str(n_batches), str(chunk)],
+                capture_output=True, text=True, timeout=timeout, env=env)
+            if proc.returncode == 0:
+                out = json.loads(proc.stdout.strip().splitlines()[-1])
+                if label != "default":
+                    out["backend_fallback"] = label
+                return out
+            err = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            err = f"{type(e).__name__}: {e}"
+    return {"error": err, "value": 0.0, "vs_baseline": 0.0,
+            "txns_per_batch": T}
+
+
+def main():
+    # e2e FIRST (and in subprocesses): the parent must not hold the TPU yet
+    e2e = None
+    if os.environ.get("FDB_TPU_BENCH_E2E", "1") != "0":
+        e2e = run_e2e()
+
+    r16 = run_kernel_watchdogged(16384, N_BATCHES, CHUNK)
+    # the 32768-point (round-3 gate: >= 1.5x at the doubled batch size)
+    r32 = run_kernel_watchdogged(32768, 100, 50)
+    out = {
+        "metric": "resolver_conflict_txns_per_sec",
+        "unit": "txns/s",
+        **r16,
+        "batch_32768": r32,
     }
     # end-to-end pipeline numbers (real TCP transport, separate server
     # processes, concurrent multi-process clients — BASELINE.md methodology
@@ -230,4 +274,9 @@ def main():
 
 
 if __name__ == "__main__":
+    import sys
+    if len(sys.argv) >= 5 and sys.argv[1] == "--kernel":
+        print(json.dumps(run_kernel(int(sys.argv[2]), int(sys.argv[3]),
+                                    int(sys.argv[4]))))
+        sys.exit(0)
     main()

@@ -1,0 +1,1 @@
+"""Client bindings: the C-ABI-shaped surface and its conformance tester."""

@@ -200,6 +200,26 @@ static void range_max_batch(const Txn *txns, uint8_t *conflict, int T,
  * begin node at v and an end node restoring the prior covering value.
  * `update` = per-level last-node-before-b fingers (found separately so the
  * searches can be interleaved like the reference's striped find :587). */
+/* recompute node `n`'s level-l maxver exactly: the max of the level-(l-1)
+ * maxvers of the span's members (maxver[0] == value is exact by
+ * construction) */
+static void fix_maxver_level(Node *n, int l) {
+    int64_t m = n->ln[l - 1].maxver;
+    Node *q = n->ln[l - 1].next;
+    Node *stop = n->ln[l].next;
+    while (q != stop) {
+        if (q->ln[l - 1].maxver > m)
+            m = q->ln[l - 1].maxver;
+        q = q->ln[l - 1].next;
+    }
+    n->ln[l].maxver = m;
+}
+
+static void fix_maxver_node(Node *n) {
+    for (int l = 1; l < n->level; l++)
+        fix_maxver_level(n, l);
+}
+
 static void insert_range_at(const uint8_t *b, const uint8_t *e, int64_t v,
                             Node **update) {
     Node *x = update[0];
@@ -245,6 +265,7 @@ static void insert_range_at(const uint8_t *b, const uint8_t *e, int64_t v,
         update[l]->ln[l].next = nb;
     }
     /* insert end node restoring the covering value, unless present */
+    Node *ne = NULL;
     if (!have_end) {
         int le = rand_level();
         if (le > cur_level) {
@@ -252,19 +273,28 @@ static void insert_range_at(const uint8_t *b, const uint8_t *e, int64_t v,
                 update[l] = head;
             cur_level = le;
         }
-        Node *ne = node_new(e, le, end_cover);
+        ne = node_new(e, le, end_cover);
         for (int l = 0; l < le; l++) {
             Node *q = (l < lv) ? nb : update[l];
             ne->ln[l].next = q->ln[l].next;
             q->ln[l].next = ne;
         }
     }
-    /* refresh maxver on the descent path: v is the global max */
-    for (int l = 0; l < cur_level; l++)
-        if (update[l]->ln[l].maxver < v)
-            update[l]->ln[l].maxver = v;
-    for (int l = 0; l < lv; l++)
-        nb->ln[l].maxver = v;
+    /* EXACT maxver maintenance (the annotations the query trusts at high
+     * levels; approximations here skew conflict decisions — caught by the
+     * oracle decision-parity test):
+     *  - nb: every level's span contains the fresh [b,e)@v segment and
+     *    v >= all stored versions, so maxver = v exactly (node_new did it).
+     *  - ne: fresh node spanning beyond e — recompute every level from the
+     *    level below (bottom-up; members' lower maxvers are final).
+     *  - update[l], l >= lv: span absorbs [b,e)@v — max is exactly v.
+     *  - update[l], l < lv: span SHRANK to [update[l], nb) — recompute. */
+    if (ne)
+        fix_maxver_node(ne);
+    for (int l = 1; l < lv; l++)
+        fix_maxver_level(update[l], l);
+    for (int l = lv; l < cur_level; l++)
+        update[l]->ln[l].maxver = v;
 }
 
 
@@ -339,8 +369,13 @@ static void remove_before(int64_t floor_v, int budget) {
     while (cur && budget-- > 0) {
         Node *nx = cur->ln[0].next;
         if (cur->value < floor_v && pred[0]->value < floor_v) {
-            for (int l = 0; l < cur->level; l++)
+            for (int l = 0; l < cur->level; l++) {
+                /* the pred's span absorbs cur's adjacent span: the union's
+                 * exact max is the max of the two stored maxes */
+                if (cur->ln[l].maxver > pred[l]->ln[l].maxver)
+                    pred[l]->ln[l].maxver = cur->ln[l].maxver;
                 pred[l]->ln[l].next = cur->ln[l].next;
+            }
             node_free(cur);
         } else {
             for (int l = 0; l < cur->level; l++)
@@ -487,7 +522,104 @@ static void setk(uint8_t *dst, uint32_t key) {
     dst[15] = key;
 }
 
+/* --parity mode: decision cross-check against an independent oracle.
+ * stdin:  "B T" then per batch a "snapshot now floor" line and T lines of
+ *         "k1 s1 k2 s2" (read lo/span, write lo/span as setk ints).
+ * stdout: per batch one line of T status digits, 0=conflict 2=committed —
+ *         the same numbering as ops/batch.py, so the Python harness diffs
+ *         the streams directly (the reference cross-checks its fast path
+ *         against a naive oracle the same way, SkipList.cpp:1394). */
+static int parity_main(void) {
+    int B, T;
+    if (scanf("%d %d", &B, &T) != 2)
+        return 2;
+    sl_init();
+    Txn *txns = malloc((size_t)T * sizeof(Txn));
+    Point *pts = malloc((size_t)T * 4 * sizeof(Point));
+    Point *ptmp = malloc((size_t)T * 4 * sizeof(Point));
+    int *pos = malloc((size_t)T * 4 * sizeof(int));
+    uint8_t *conflict = malloc(T);
+    bits = calloc(((size_t)T * 4 + 63) / 64 + 2, 8);
+    sum = calloc((((size_t)T * 4 + 63) / 64 + 63) / 64 + 2, 8);
+    Point *wsort = malloc((size_t)T * sizeof(Point));
+    uint8_t(*cbs)[KEYB] = malloc((size_t)T * KEYB);
+    uint8_t(*ces)[KEYB] = malloc((size_t)T * KEYB);
+    Node **fingers = malloc((size_t)T * MAX_LEVEL * sizeof(Node *));
+    char *out = malloc((size_t)T + 2);
+    for (int i = 0; i < B; i++) {
+        long long snapshot, now, floor_v;
+        if (scanf("%lld %lld %lld", &snapshot, &now, &floor_v) != 3)
+            return 2;
+        for (int j = 0; j < T; j++) {
+            uint32_t k1, s1, k2, s2;
+            if (scanf("%u %u %u %u", &k1, &s1, &k2, &s2) != 4)
+                return 2;
+            setk(txns[j].rb, k1);
+            setk(txns[j].re, k1 + s1);
+            setk(txns[j].wb, k2);
+            setk(txns[j].we, k2 + s2);
+        }
+        range_max_batch(txns, conflict, T, snapshot);
+        for (int j = 0; j < T; j++) {
+            memcpy(pts[4 * j + 0].key, txns[j].rb, KEYB);
+            pts[4 * j + 0].idx = 4 * j + 0;
+            memcpy(pts[4 * j + 1].key, txns[j].re, KEYB);
+            pts[4 * j + 1].idx = 4 * j + 1;
+            memcpy(pts[4 * j + 2].key, txns[j].wb, KEYB);
+            pts[4 * j + 2].idx = 4 * j + 2;
+            memcpy(pts[4 * j + 3].key, txns[j].we, KEYB);
+            pts[4 * j + 3].idx = 4 * j + 3;
+        }
+        radix_sort_points(pts, ptmp, T * 4);
+        for (int p = 0; p < T * 4; p++)
+            pos[pts[p].idx] = p;
+        mcs_reset(T * 4);
+        for (int j = 0; j < T; j++) {
+            if (conflict[j])
+                continue;
+            if (mcs_any(pos[4 * j + 0], pos[4 * j + 1]))
+                conflict[j] = 1;
+            else
+                mcs_set(pos[4 * j + 2], pos[4 * j + 3]);
+        }
+        int nw = 0;
+        for (int j = 0; j < T; j++)
+            if (!conflict[j]) {
+                memcpy(wsort[nw].key, txns[j].wb, KEYB);
+                wsort[nw].idx = j;
+                nw++;
+            }
+        qsort(wsort, nw, sizeof(Point), point_cmp);
+        int nc = 0;
+        for (int w = 0; w < nw; w++) {
+            const Txn *tx = &txns[wsort[w].idx];
+            if (nc && memcmp(tx->wb, ces[nc - 1], KEYB) <= 0) {
+                if (memcmp(tx->we, ces[nc - 1], KEYB) > 0)
+                    memcpy(ces[nc - 1], tx->we, KEYB);
+            } else {
+                memcpy(cbs[nc], tx->wb, KEYB);
+                memcpy(ces[nc], tx->we, KEYB);
+                nc++;
+            }
+        }
+        find_fingers_batch(cbs, nc, fingers);
+        for (int w = nc - 1; w >= 0; w--)
+            insert_range_at(cbs[w], ces[w], now,
+                            fingers + (size_t)w * MAX_LEVEL);
+        remove_before(floor_v, 3 * nw + 10);
+        for (int j = 0; j < T; j++)
+            out[j] = conflict[j] ? '0' : '2';
+        out[T] = '\n';
+        out[T + 1] = 0;
+        fputs(out, stdout);
+    }
+    fflush(stdout);
+    return 0;
+}
+
 int main(int argc, char **argv) {
+    if (argc > 1 && strcmp(argv[1], "--parity") == 0)
+        return parity_main();
     int T = argc > 1 ? atoi(argv[1]) : 2500; /* txns per batch */
     int B = argc > 2 ? atoi(argv[2]) : 500;  /* batches */
     sl_init();

@@ -88,15 +88,18 @@ def main(spec_json: str):
     print(f"ready {spec['listen']} roles={[r['role'] for r in spec['roles']]}",
           flush=True)
     import os
+    import signal
+    # graceful SIGTERM always: unwind through finally so the transport
+    # closes and, on device-backend servers, the accelerator client is
+    # destroyed cleanly — a hard kill mid-dispatch can wedge a
+    # remote-attached device runtime for every later client
+    signal.signal(signal.SIGTERM,
+                  lambda *_a: loop.aio.call_soon_threadsafe(loop.aio.stop))
     prof_path = os.environ.get("FDBTPU_PROFILE")
     if prof_path:
         import cProfile
-        import signal
         pr = cProfile.Profile()
         pr.enable()
-        # SIGTERM must unwind through finally so the profile is written
-        signal.signal(signal.SIGTERM,
-                      lambda *_a: loop.aio.call_soon_threadsafe(loop.aio.stop))
     try:
         loop.aio.run_forever()
     finally:

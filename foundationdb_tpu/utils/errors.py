@@ -88,5 +88,15 @@ def error_code(name: str) -> int:
     return _ERRORS[name][0]
 
 
+def error_name(code: int) -> str:
+    """Numeric code -> canonical name (fdb_get_error analogue)."""
+    return _BY_CODE.get(code, "unknown_error")
+
+
+def is_retryable_code(code: int) -> bool:
+    name = _BY_CODE.get(code)
+    return bool(name and _ERRORS[name][1])
+
+
 def err(name: str, detail: str = "") -> FDBError:
     return FDBError(name, detail)
