@@ -87,7 +87,20 @@ class _Disabled:
 DISABLED = _Disabled()
 
 
-def of(net):
+def of(net, scope: str = ""):
     """The oracle attached to a network (SimNetwork carries one); no-op for
-    real transports."""
-    return getattr(net, "validation", None) or DISABLED
+    real transports. `scope` separates DATABASES sharing one simulation
+    (the DR topology runs two live clusters on one SimNetwork): external
+    consistency is a per-database invariant — cluster B's acked commits
+    must not raise cluster A's GRV floor."""
+    base = getattr(net, "validation", None)
+    if base is None:
+        return DISABLED
+    if not scope:
+        return base
+    scoped = getattr(net, "_validation_scoped", None)
+    if scoped is None:
+        scoped = net._validation_scoped = {}
+    if scope not in scoped:
+        scoped[scope] = type(base)()
+    return scoped[scope]

@@ -29,20 +29,26 @@ from foundationdb_tpu.utils.keys import partition_boundaries as _partition_bound
 
 class SimCluster:
     def __init__(self, seed: int = 0, n_proxies: int = 1, n_resolvers: int = 1,
-                 n_tlogs: int = 1, n_storage: int = 1):
-        self.loop = EventLoop()
+                 n_tlogs: int = 1, n_storage: int = 1,
+                 loop: EventLoop | None = None,
+                 net: SimNetwork | None = None, name_prefix: str = ""):
+        """`loop`/`net`/`name_prefix` let several clusters share one
+        deterministic simulation (the DR topology: two live databases)."""
+        self.loop = loop or EventLoop()
         self.rng = DeterministicRandom(seed)
-        self.net = SimNetwork(self.loop, self.rng.fork())
+        self.net = net or SimNetwork(self.loop, self.rng.fork())
+        self.name_prefix = name_prefix
+        P = name_prefix
 
         # -- processes --
-        self.master_proc = self.net.new_process("master:0", dc_id="dc0")
-        self.proxy_procs = [self.net.new_process(f"proxy:{i}") for i in range(n_proxies)]
-        self.resolver_procs = [self.net.new_process(f"resolver:{i}") for i in range(n_resolvers)]
-        self.tlog_procs = [self.net.new_process(f"tlog:{i}") for i in range(n_tlogs)]
-        self.storage_procs = [self.net.new_process(f"storage:{i}") for i in range(n_storage)]
+        self.master_proc = self.net.new_process(f"{P}master:0", dc_id="dc0")
+        self.proxy_procs = [self.net.new_process(f"{P}proxy:{i}") for i in range(n_proxies)]
+        self.resolver_procs = [self.net.new_process(f"{P}resolver:{i}") for i in range(n_resolvers)]
+        self.tlog_procs = [self.net.new_process(f"{P}tlog:{i}") for i in range(n_tlogs)]
+        self.storage_procs = [self.net.new_process(f"{P}storage:{i}") for i in range(n_storage)]
 
         # -- endpoints --
-        master_ep = Endpoint("master:0", Token.MASTER_GET_COMMIT_VERSION)
+        master_ep = Endpoint(f"{P}master:0", Token.MASTER_GET_COMMIT_VERSION)
         resolver_eps = [Endpoint(p.address, Token.RESOLVER_RESOLVE)
                         for p in self.resolver_procs]
         tlog_eps = [Endpoint(p.address, Token.TLOG_COMMIT) for p in self.tlog_procs]
@@ -92,12 +98,14 @@ class SimCluster:
         self.proxies = [
             Proxy(p, proxy_id=i, master=master_ep, resolvers=resolver_map,
                   tlogs=tlog_eps, shards=shard_map,
-                  other_proxies=[a for a in self.proxy_addrs if a != p.address])
+                  other_proxies=[a for a in self.proxy_addrs if a != p.address],
+                  validation_scope=name_prefix)
             for i, p in enumerate(self.proxy_procs)]
 
     # -- client handles --
 
     def database(self, name: str = "client:0") -> Database:
+        name = self.name_prefix + name
         from foundationdb_tpu.client.database import LocationCache
         proc = self.net.processes.get(name) or self.net.new_process(name)
         cache = LocationCache(self.shard_boundaries,

@@ -111,11 +111,16 @@ class Proxy:
                  system_snapshot: list | None = None,
                  storages: list | None = None,
                  satellites: list[Endpoint] | None = None,
-                 satellite_uids: list[str] | None = None):
+                 satellite_uids: list[str] | None = None,
+                 validation_scope: str = ""):
         from foundationdb_tpu.server import systemdata
         self.process = process
         self.loop = process.net.loop
         self.proxy_id = proxy_id
+        # sim-only: which DATABASE this proxy belongs to, for the external-
+        # consistency oracle — "" (the per-network global oracle, strongest:
+        # it survives recoveries) unless several clusters share one sim
+        self.validation_scope = validation_scope
         self.master = master
         self.epoch = epoch
         self.resolvers = resolvers
@@ -429,11 +434,13 @@ class Proxy:
         self._serve_grv(reply)
 
     def _serve_grv(self, reply):
-        floor = sim_validation.of(self.process.net).debug_grv_floor()
+        floor = sim_validation.of(self.process.net,
+                                  self.validation_scope).debug_grv_floor()
         if not self.other_proxies:
             self.grv_bands.add(0.0)
             v = self.committed_version.get()
-            sim_validation.of(self.process.net).debug_check_read_version(
+            sim_validation.of(
+                self.process.net, self.validation_scope).debug_check_read_version(
                 v, floor, self.process.address)
             reply.send(GetReadVersionReply(version=v))
             return
@@ -452,7 +459,8 @@ class Proxy:
             self.grv_bands.add(self.loop.now() - t0)
             # external consistency oracle: >= every commit acked before the
             # GRV arrived (debug_checkMinCommittedVersion)
-            sim_validation.of(self.process.net).debug_check_read_version(
+            sim_validation.of(
+                self.process.net, self.validation_scope).debug_check_read_version(
                 version, floor, self.process.address)
             reply.send(GetReadVersionReply(version=version))
         except FDBError as e:
@@ -716,7 +724,9 @@ class Proxy:
                 # sim-only oracle (debug_advanceMaxCommittedVersion,
                 # MasterProxyServer.actor.cpp:820): acked versions are
                 # unique per batch, and every later GRV must be >= this
-                sim_validation.of(self.process.net).debug_advance_max_committed(
+                sim_validation.of(
+                    self.process.net,
+                    self.validation_scope).debug_advance_max_committed(
                     commit_version, f"{self.process.address}/b{batch_n}")
         except Exception as e:  # noqa: BLE001
             # a failed stage fails the whole batch; clients retry
