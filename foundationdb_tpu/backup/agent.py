@@ -184,17 +184,36 @@ class BackupAgent:
 
 
 class RestoreAgent:
-    """Apply a container into a (fresh) cluster: chunks first, then log
-    mutations above each chunk's version floor, up to the end version."""
+    """Apply a container into a cluster: chunks first, then log mutations
+    above each chunk's version floor, up to the target version.
+
+    Works against a LIVE cluster (Restore.actor.cpp's restore-into-running-
+    database): every backed-up range is cleared before its chunk lands, so
+    existing data under the restored ranges is replaced transactionally
+    range by range; data outside them is untouched. `target_version` makes
+    it point-in-time: any version in [max chunk version, end_version] —
+    below the chunk floor there is no consistent base to roll forward from
+    (fdbclient/FileBackupAgent.actor.cpp:941 restorable-version rules)."""
 
     def __init__(self, db, container):
         self.db = db
         self.container = container
 
-    async def restore(self) -> int:
+    async def restore(self, target_version: int | None = None) -> int:
         from foundationdb_tpu.utils.types import Mutation, MutationType
         meta = self.container.read_file("meta")
         end_version = meta["end_version"]
+        chunk_versions = [self.container.read_file(n)["version"]
+                          for n in self.container.list_files("kvrange-")]
+        min_restorable = max(chunk_versions) if chunk_versions else 0
+        if target_version is None:
+            target_version = end_version
+        if not min_restorable <= target_version <= end_version:
+            raise FDBError(
+                "restore_invalid_version",
+                f"target {target_version} outside restorable window "
+                f"[{min_restorable}, {end_version}]")
+        end_version = target_version
         floors: list[tuple[bytes, int]] = []  # (chunk begin, version)
         chunk_ends: dict[bytes, bytes] = {}
         for name in self.container.list_files("kvrange-"):

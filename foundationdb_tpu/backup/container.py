@@ -28,6 +28,62 @@ class BackupContainer:
         return sorted(n for n in self._files if n.startswith(prefix))
 
 
+class BlobStoreBackupContainer(BackupContainer):
+    """Object-store container over HTTP (fdbrpc/BlobStore.actor.cpp): files
+    are objects under <bucket>/<name>, written with a CRC-32C integrity
+    header that reads verify, with bounded retries around every request."""
+
+    def __init__(self, url: str, bucket: str = "backup", retries: int = 3):
+        from foundationdb_tpu.net.http import HTTPConnection, HTTPError, _crc32c
+        assert url.startswith("blobstore://"), url
+        hostport = url[len("blobstore://"):].rstrip("/")
+        host, _, port = hostport.partition(":")
+        self._conn = HTTPConnection(host, int(port))
+        self._bucket = bucket
+        self._retries = retries
+        self._HTTPError = HTTPError
+        self._crc = _crc32c
+
+    def _request(self, method, path, headers=None, body=b""):
+        last = None
+        for _ in range(self._retries):
+            try:
+                return self._conn.request(method, path, headers, body)
+            except (OSError, self._HTTPError) as e:
+                last = e
+        raise self._HTTPError(f"blobstore request failed: {last}")
+
+    def write_file(self, name: str, obj) -> None:
+        from urllib.parse import quote
+        data = wire.dumps(obj)
+        status, _h, _b = self._request(
+            "PUT", f"/{self._bucket}/{quote(name)}",
+            {"x-crc32c": str(self._crc(data))}, data)
+        if status != 200:
+            raise self._HTTPError(f"PUT {name}: HTTP {status}")
+
+    def read_file(self, name: str):
+        from urllib.parse import quote
+        status, headers, body = self._request(
+            "GET", f"/{self._bucket}/{quote(name)}")
+        if status == 404:
+            raise KeyError(name)
+        if status != 200:
+            raise self._HTTPError(f"GET {name}: HTTP {status}")
+        want = headers.get("x-crc32c")
+        if want is not None and int(want) != self._crc(body):
+            raise self._HTTPError(f"GET {name}: checksum mismatch")
+        return wire.loads(body)
+
+    def list_files(self, prefix: str = "") -> list[str]:
+        from urllib.parse import quote
+        status, _h, body = self._request(
+            "GET", f"/{self._bucket}?prefix={quote(prefix)}")
+        if status != 200:
+            raise self._HTTPError(f"LIST: HTTP {status}")
+        return [n for n in body.decode().split("\n") if n]
+
+
 class DirBackupContainer(BackupContainer):
     """Directory-backed container (wire-encoded files on disk)."""
 

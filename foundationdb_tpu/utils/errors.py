@@ -47,6 +47,7 @@ _ERRORS = {
     "key_too_large": (2102, False),
     "value_too_large": (2103, False),
     "transaction_too_large": (2101, False),
+    "restore_invalid_version": (2224, False),
     "unknown_error": (4000, False),
     "internal_error": (4100, False),
     # Internal to the pipeline (not in the reference's numbering):

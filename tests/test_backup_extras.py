@@ -1,0 +1,142 @@
+"""Blob-store backup container + point-in-time restore into a live cluster.
+
+Reference: fdbrpc/BlobStore.actor.cpp + HTTP.actor.cpp (the remote object
+container, round 4 VERDICT ask 9) and Restore.actor.cpp /
+FileBackupAgent.actor.cpp:941 (version-targeted restore into a running
+database, ask 10).
+"""
+
+import pytest
+
+from foundationdb_tpu.backup import BackupAgent, RestoreAgent
+from foundationdb_tpu.backup.container import BlobStoreBackupContainer
+from foundationdb_tpu.net.http import BlobStoreServer, HTTPConnection
+from foundationdb_tpu.server.cluster import SimCluster
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.types import MutationType
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+def _user_rows(rows):
+    return [(k, v) for k, v in rows if not k.startswith(b"\xff")]
+
+
+async def read_all(db):
+    async def rd(tr):
+        return await tr.get_range(b"", b"\xff", limit=100_000)
+    return _user_rows(await db.transact(rd, max_retries=500))
+
+
+def test_blobstore_http_protocol():
+    """The HTTP client + object-store server speak the S3-ish subset:
+    put/get round trip, integrity header, 404, prefix listing."""
+    srv = BlobStoreServer()
+    try:
+        conn = HTTPConnection(srv.host, srv.port)
+        st, _h, _b = conn.request("PUT", "/b/x%20y", {"x-crc32c": "0"},
+                                  b"payload")
+        assert st == 200
+        st, h, body = conn.request("GET", "/b/x%20y")
+        assert st == 200 and body == b"payload" and "x-crc32c" in h
+        st, _h, body = conn.request("GET", "/b/missing")
+        assert st == 404
+        conn.request("PUT", "/b/log-1", {}, b"a")
+        conn.request("PUT", "/b/log-2", {}, b"b")
+        st, _h, body = conn.request("GET", "/b?prefix=log-")
+        assert st == 200 and body == b"log-1\nlog-2"
+        st, _h, _b = conn.request("DELETE", "/b/log-1")
+        st, _h, body = conn.request("GET", "/b?prefix=log-")
+        assert body == b"log-2"
+    finally:
+        srv.close()
+
+
+def test_backup_to_blobstore_and_pit_restore_into_live_cluster():
+    """Full arc: back up THROUGH the blob store under write load, then
+    restore into a LIVE cluster (dirty data present) at a point-in-time
+    target — the result equals the source exactly at that version; a
+    full-version restore equals the final source state; a target below the
+    restorable window is rejected."""
+    srv = BlobStoreServer()
+    src = SimCluster(seed=21, n_proxies=2, n_storage=2)
+    db = src.database()
+    container = BlobStoreBackupContainer(srv.url)
+
+    async def t():
+        async def seed(tr):
+            for i in range(60):
+                tr.set(b"pre/%03d" % i, b"v%d" % i)
+        await db.transact(seed, max_retries=200)
+
+        agent = BackupAgent(db, container, chunks=4)
+        await agent.start()
+        await agent.run_agent()  # snapshot chunks -> blob store
+        tailer = src.loop.spawn(agent.run_log_tailer(), name="tailer")
+
+        # phase A: writes that belong to the PIT image
+        async def phase_a(tr):
+            for i in range(20):
+                tr.set(b"live/a%03d" % i, b"A%d" % i)
+            tr.clear_range(b"pre/000", b"pre/005")
+            tr.atomic_op(MutationType.ADD_VALUE, b"ctr",
+                         (3).to_bytes(8, "little"))
+        await db.transact(phase_a, max_retries=200)
+        marker_tr = [None]
+
+        async def marker(tr):
+            marker_tr[0] = tr
+            tr.set(b"\xff/pit-fence", b"x")
+        await db.transact(marker, max_retries=200)
+        t_a = marker_tr[0].committed_version
+        expected_a = await read_all(db)
+
+        # phase B: writes BEYOND the PIT target
+        async def phase_b(tr):
+            for i in range(10):
+                tr.set(b"live/b%03d" % i, b"B%d" % i)
+            tr.clear_range(b"live/a000", b"live/a003")
+            tr.atomic_op(MutationType.ADD_VALUE, b"ctr",
+                         (9).to_bytes(8, "little"))
+        await db.transact(phase_b, max_retries=200)
+        await agent.stop()
+        await tailer
+        expected_full = await read_all(db)
+        assert expected_full != expected_a
+
+        # destination: a LIVE cluster with pre-existing junk everywhere
+        dst = SimCluster(seed=22, n_storage=2, loop=src.loop, net=src.net,
+                         name_prefix="dst-")
+        ddb = dst.database("clientD:0")
+
+        async def dirty(tr):
+            for i in range(30):
+                tr.set(b"pre/%03d" % i, b"JUNK")
+                tr.set(b"live/a%03d" % i, b"JUNK")
+            tr.set(b"ctr", b"JUNK8byte")
+        await ddb.transact(dirty, max_retries=200)
+
+        # point-in-time restore at t_a
+        restore = RestoreAgent(ddb, container)
+        await restore.restore(target_version=t_a)
+        got = await read_all(ddb)
+        assert got == expected_a, \
+            (f"PIT restore diverges: {len(got)} vs {len(expected_a)} rows; "
+             f"diff {set(got) ^ set(expected_a)}")
+
+        # full restore over the SAME live cluster reaches the final state
+        await restore.restore()
+        assert await read_all(ddb) == expected_full
+
+        # a target below the restorable window is rejected loudly
+        with pytest.raises(FDBError) as ei:
+            await restore.restore(target_version=1)
+        assert ei.value.name == "restore_invalid_version"
+
+    src.run(src.loop.spawn(t()), max_time=600_000.0)
+    srv.close()
