@@ -295,6 +295,15 @@ class FDBTransaction:
 
     # reads return FDBFuture handles, like the header
 
+    def set_option(self, option: int, param: bytes | None = None) -> int:
+        """fdb_transaction_set_option: the generated option surface
+        (utils/fdboptions.py) supplies the codes."""
+        try:
+            self._tr.set_option(option, param)
+        except FDBError as e:
+            return _err(e.name)
+        return 0
+
     def get_read_version(self) -> FDBFuture:
         return _network.submit(self._tr.get_read_version(), "capiGRV")
 

@@ -24,7 +24,43 @@ from foundationdb_tpu.utils.types import ATOMIC_OPS, MutationType
 class Transaction:
     def __init__(self, db):
         self.db = db
+        # options survive reset() like the reference's persistent defaults
+        # (fdb.options: timeout/retry_limit/size_limit "persist across
+        # resets" from API 610 on)
+        self._opt_timeout_ms: int | None = None
+        self._opt_retry_limit: int | None = None
+        self._opt_size_limit: int | None = None
+        self._retries = 0
         self.reset()
+
+    def set_option(self, option, param=None):
+        """fdb_transaction_set_option: options come from the generated
+        surface (utils/fdboptions.TransactionOption) or raw codes."""
+        code = int(option)
+        if code == 500:  # timeout (ms)
+            self._opt_timeout_ms = int.from_bytes(param, "little") \
+                if isinstance(param, (bytes, bytearray)) else int(param)
+        elif code == 501:  # retry_limit
+            self._opt_retry_limit = int.from_bytes(param, "little") \
+                if isinstance(param, (bytes, bytearray)) else int(param)
+        elif code == 503:  # size_limit
+            self._opt_size_limit = int.from_bytes(param, "little") \
+                if isinstance(param, (bytes, bytearray)) else int(param)
+        else:
+            from foundationdb_tpu.utils.fdboptions import (
+                transaction_option_by_code)
+            if code not in transaction_option_by_code:
+                raise FDBError("invalid_option_value", f"unknown option {code}")
+            # known but advisory here (risky reads, system-keys gates, trace
+            # identifiers): accepted for API compatibility
+
+    def _deadline_guard(self, fut):
+        """Wrap an awaited future with the transaction's timeout option
+        (NativeAPI: timed-out transactions raise transaction_timed_out,
+        surfaced here as the retryable timed_out)."""
+        if self._opt_timeout_ms is None:
+            return fut
+        return self.db.loop.timeout(fut, self._opt_timeout_ms / 1000.0)
 
     def reset(self):
         self._writes = WriteMap()
@@ -40,7 +76,7 @@ class Transaction:
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            reply = await self.db._grv()
+            reply = await self._deadline_guard(self.db._grv())
             self._read_version = reply.version
         return self._read_version
 
@@ -275,7 +311,7 @@ class Transaction:
                 mutations=list(self._writes.mutations))
             self._check_size(req)
             try:
-                reply = await self.db._commit(req)
+                reply = await self._deadline_guard(self.db._commit(req))
             except FDBError as e:
                 if e.name in ("request_maybe_delivered", "timed_out",
                               "broken_promise"):
@@ -298,8 +334,12 @@ class Transaction:
 
     async def on_error(self, error: FDBError):
         """The retry contract (NativeAPI Transaction::onError :2180): backoff
-        then reset, re-raise if not retryable."""
+        then reset, re-raise if not retryable (or past retry_limit)."""
         if not isinstance(error, FDBError) or not error.is_retryable:
+            raise error
+        self._retries += 1
+        if (self._opt_retry_limit is not None
+                and self._retries > self._opt_retry_limit):
             raise error
         backoff = self._backoff
         await self.db.loop.delay(backoff * (0.5 + self.db._rng.random()))
@@ -320,7 +360,10 @@ class Transaction:
     def _check_size(self, req: CommitTransactionRequest):
         size = sum(m.weight() for m in req.mutations)
         size += sum(len(b) + len(e) for b, e in req.read_conflict_ranges)
-        if size > KNOBS.TRANSACTION_SIZE_LIMIT:
+        limit = KNOBS.TRANSACTION_SIZE_LIMIT
+        if self._opt_size_limit is not None:
+            limit = min(limit, self._opt_size_limit)
+        if size > limit:
             raise FDBError("transaction_too_large")
 
 

@@ -100,12 +100,20 @@ def main(spec_json: str):
         import cProfile
         pr = cProfile.Profile()
         pr.enable()
+    sampler = None
+    if os.environ.get("FDBTPU_SAMPLING_PROFILE"):
+        from foundationdb_tpu.utils.profiler import SamplingProfiler
+        sampler = SamplingProfiler()
+        sampler.start()
     try:
         loop.aio.run_forever()
     finally:
         if prof_path:
             pr.disable()
             pr.dump_stats(f"{prof_path}.{spec['listen'].replace(':', '_')}")
+        if sampler is not None:
+            sampler.stop()
+            sampler.trace_report(who=spec["listen"])
         net.close()
         del roles
 

@@ -167,10 +167,14 @@ class NetTransport:
     """
 
     def __init__(self, loop: RealEventLoop, listen_address: str,
-                 data_dir: str = "/tmp/fdbtpu"):
+                 data_dir: str = "/tmp/fdbtpu", tls=None):
         self.loop = loop
         self.address = listen_address
         self.data_dir = data_dir
+        # optional mutual TLS (net/tls.TLSConfig — the FDBLibTLS analogue):
+        # both the listener and outgoing peer connections wrap in it, and
+        # the verify_peers clauses gate every accepted/established session
+        self.tls = tls
         self.process = NetProcess(self, listen_address)
         self.processes = {listen_address: self.process}  # sim-API parity
         self._server = None
@@ -202,7 +206,8 @@ class NetTransport:
         # (start_server's own wrapping would bypass _spawn and leak at close)
         self._server = await asyncio.start_server(
             lambda r, w: self._spawn(self._on_connection(r, w)),
-            host, int(port))
+            host, int(port),
+            ssl=self.tls.server_context() if self.tls else None)
 
     def start(self):
         self.loop.aio.run_until_complete(self._aio_start())
@@ -256,7 +261,12 @@ class NetTransport:
         self._peers[address] = fut
         try:
             host, port = address.rsplit(":", 1)
-            _r, w = await asyncio.open_connection(host, int(port))
+            _r, w = await asyncio.open_connection(
+                host, int(port),
+                ssl=self.tls.client_context() if self.tls else None)
+            if self.tls is not None and not self._peer_ok(w):
+                w.close()
+                raise OSError("peer failed verify_peers")
         except OSError as e:
             self._peers.pop(address, None)
             fut.set_exception(e)
@@ -397,10 +407,20 @@ class NetTransport:
             raise ConnectionError(f"bad wire frame: {e}") from e
         return token, reply_id, kind, payload
 
+    def _peer_ok(self, writer) -> bool:
+        """Apply the TLS verify_peers clauses to the session's peer cert
+        (FDBLibTLSSession::verify_peer)."""
+        sslobj = writer.get_extra_info("ssl_object")
+        cert = sslobj.getpeercert() if sslobj is not None else None
+        return self.tls.check_peer(cert)
+
     async def _on_connection(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
         self._incoming.add(writer)
         try:
+            if self.tls is not None and not self._peer_ok(writer):
+                writer.close()
+                return
             connect = await reader.readexactly(len(_CONNECT))
             if connect != _CONNECT:
                 writer.close()  # protocol mismatch (ConnectPacket check :206)

@@ -1,0 +1,190 @@
+"""Options codegen, transaction options, sampling profiler, transport TLS.
+
+Reference: fdbclient/vexillographer/fdb.options (+ the generated binding
+option surfaces), flow/Profiler.actor.cpp (sampling profiler),
+FDBLibTLS/* (mutual TLS with verify_peers clauses).
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from foundationdb_tpu.server.cluster import SimCluster
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+# ---------------------------------------------------------------- options
+
+def test_options_codegen_is_stable_and_complete():
+    """The checked-in fdboptions.py is exactly what the generator emits,
+    and carries the public codes bindings rely on."""
+    from foundationdb_tpu.utils import option_spec
+    gen = option_spec.generate_source()
+    path = os.path.join(os.path.dirname(option_spec.__file__),
+                        "fdboptions.py")
+    with open(path) as f:
+        assert f.read() == gen, \
+            "fdboptions.py is stale: rerun python -m foundationdb_tpu.utils.option_spec"
+    from foundationdb_tpu.utils.fdboptions import (
+        DatabaseOption, NetworkOption, StreamingMode, TransactionOption)
+    assert int(TransactionOption.timeout) == 500
+    assert int(TransactionOption.retry_limit) == 501
+    assert int(TransactionOption.size_limit) == 503
+    assert int(DatabaseOption.transaction_timeout) == 500
+    assert int(NetworkOption.tls_verify_peers) == 41
+    assert int(StreamingMode.want_all) == -2
+
+
+def test_transaction_options_honored():
+    from foundationdb_tpu.utils.fdboptions import TransactionOption
+    c = SimCluster(seed=71)
+    db = c.database()
+
+    async def t():
+        # size_limit: a txn over its own limit is rejected client-side
+        tr = db.create_transaction()
+        tr.set_option(TransactionOption.size_limit, 64)
+        tr.set(b"k", b"v" * 256)
+        with pytest.raises(FDBError) as ei:
+            await tr.commit()
+        assert ei.value.name == "transaction_too_large"
+        # retry_limit: on_error gives up after N retries
+        tr = db.create_transaction()
+        tr.set_option(TransactionOption.retry_limit, 2)
+        err = FDBError("not_committed")
+        await tr.on_error(err)
+        await tr.on_error(err)
+        with pytest.raises(FDBError):
+            await tr.on_error(err)
+        # unknown option code is rejected, known advisory ones accepted
+        tr = db.create_transaction()
+        with pytest.raises(FDBError):
+            tr.set_option(99999)
+        tr.set_option(TransactionOption.causal_read_risky)
+        # timeout: a commit against nothing reachable times out instead of
+        # hanging (GRV goes to a dead proxy)
+        dead = db.create_transaction()
+        dead.set_option(TransactionOption.timeout, 500)
+        c.net.kill(c.proxy_procs[0].address)
+        dead.set(b"x", b"y")
+        with pytest.raises(FDBError) as ei:
+            await dead.commit()
+        assert ei.value.name in ("timed_out", "commit_unknown_result",
+                                 "request_maybe_delivered")
+
+    c.run(c.loop.spawn(t()), max_time=600.0)
+
+
+# ---------------------------------------------------------------- profiler
+
+def test_sampling_profiler_finds_the_hot_function():
+    from foundationdb_tpu.utils.profiler import SamplingProfiler
+
+    def hot_spin(deadline):
+        x = 0
+        while time.monotonic() < deadline:
+            x += 1
+        return x
+
+    p = SamplingProfiler(interval=0.001)
+    p.start()
+    hot_spin(time.monotonic() + 0.4)
+    report = p.stop()
+    assert p.total_samples > 20
+    hottest = p.hottest_functions(top=3)
+    assert any("hot_spin" in label for label, _n in hottest), hottest
+    assert report and report[0][1] >= 1
+    p.trace_report()  # must not raise
+
+
+# ---------------------------------------------------------------- TLS
+
+def _make_certs(tmp, ca_cn="fdbtpu-ca"):
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True)
+    ca_key, ca_crt = tmp / "ca.key", tmp / "ca.crt"
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", f"/CN={ca_cn}")
+    out = {}
+    for name in ("server", "client"):
+        key, csr, crt = tmp / f"{name}.key", tmp / f"{name}.csr", tmp / f"{name}.crt"
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}")
+        run("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+            "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(crt),
+            "-days", "1")
+        out[name] = (str(crt), str(key))
+    return str(ca_crt), out
+
+
+def test_transport_tls_mutual_auth_and_verify_peers(tmp_path):
+    from foundationdb_tpu.net.tls import TLSConfig
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    import socket
+
+    try:
+        ca, certs = _make_certs(tmp_path)
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        pytest.skip("openssl unavailable")
+
+    def free_addr():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        a = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        return a
+
+    loop = RealEventLoop()
+    server_tls = TLSConfig(*certs["server"], ca_path=ca,
+                           verify_peers="Check.Valid=1,I.CN=fdbtpu-ca")
+    client_tls = TLSConfig(*certs["client"], ca_path=ca)
+    srv = NetTransport(loop, free_addr(), tls=server_tls)
+    cli = NetTransport(loop, free_addr(), tls=client_tls)
+    srv.start()
+    cli.start()
+    srv.process.register(7001, lambda req, reply: reply.send(req + b"!"))
+
+    from foundationdb_tpu.core.sim import Endpoint
+
+    async def roundtrip():
+        return await cli.request(cli.process, Endpoint(srv.address, 7001),
+                                 b"hello")
+    got = loop.run_future(loop.spawn(roundtrip()), max_time=30.0)
+    assert got == b"hello!"
+
+    # an un-authenticated (wrong-CA) client is rejected by the handshake
+    (tmp_path / "other").mkdir(exist_ok=True)
+    ca2, certs2 = _make_certs(tmp_path / "other", ca_cn="evil-ca")
+    bad = NetTransport(loop, free_addr(),
+                       tls=TLSConfig(*certs2["client"], ca_path=ca2))
+    bad.start()
+
+    async def bad_roundtrip():
+        return await loop.timeout(
+            bad.request(bad.process, Endpoint(srv.address, 7001), b"x"), 5.0)
+    with pytest.raises(FDBError):
+        loop.run_future(loop.spawn(bad_roundtrip()), max_time=30.0)
+
+    # verify_peers clause mismatch fails even with a VALID chain
+    assert not TLSConfig(*certs["server"], ca_path=ca,
+                         verify_peers="Check.Valid=1,S.CN=somebody-else") \
+        .check_peer({"subject": ((("commonName", "client"),),),
+                     "issuer": ((("commonName", "fdbtpu-ca"),),)})
+    assert TLSConfig(*certs["server"], ca_path=ca,
+                     verify_peers="Check.Valid=1,S.CN=client") \
+        .check_peer({"subject": ((("commonName", "client"),),),
+                     "issuer": ((("commonName", "fdbtpu-ca"),),)})
+
+    cli.close()
+    bad.close()
+    srv.close()
