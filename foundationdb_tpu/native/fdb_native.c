@@ -771,6 +771,383 @@ done:
     return ret;
 }
 
+/* ------------------------------------------------------------------ */
+/* IndexedSet: ordered bytes->metric map with count+sum augmentation    */
+/*                                                                     */
+/* The flow/IndexedSet.h analogue: O(log n) insert/erase/rank/nth and  */
+/* O(log n) metric sums over arbitrary key ranges (the structure       */
+/* storage byte-sampling and shard metrics hang off). A deterministic  */
+/* per-instance xorshift drives levels, so sim runs replay exactly.    */
+/* ------------------------------------------------------------------ */
+
+#define OM_MAX_LEVEL 32
+
+typedef struct OMNode {
+    PyObject *key; /* owned bytes */
+    int64_t metric;
+    int level;
+    struct OMLink {
+        struct OMNode *next;
+        int64_t cnt; /* level-0 nodes in (this, next] */
+        int64_t sum; /* their metrics */
+    } ln[1];
+} OMNode;
+
+typedef struct {
+    PyObject_HEAD
+    OMNode *head;
+    int cur_level;
+    Py_ssize_t n;
+    uint64_t rng;
+} OMap;
+
+static int om_keycmp(PyObject *a, PyObject *b) {
+    Py_ssize_t la = PyBytes_GET_SIZE(a), lb = PyBytes_GET_SIZE(b);
+    Py_ssize_t m = la < lb ? la : lb;
+    int c = memcmp(PyBytes_AS_STRING(a), PyBytes_AS_STRING(b), m);
+    if (c)
+        return c;
+    return la < lb ? -1 : (la > lb ? 1 : 0);
+}
+
+static OMNode *om_node_new(PyObject *key, int64_t metric, int level) {
+    OMNode *x = malloc(sizeof(OMNode) + (level - 1) * sizeof(struct OMLink));
+    if (!x)
+        return NULL;
+    Py_XINCREF(key);
+    x->key = key;
+    x->metric = metric;
+    x->level = level;
+    memset(x->ln, 0, level * sizeof(struct OMLink));
+    return x;
+}
+
+static int om_rand_level(OMap *self) {
+    uint64_t r = self->rng;
+    r ^= r << 13;
+    r ^= r >> 7;
+    r ^= r << 17;
+    self->rng = r;
+    int lv = 1;
+    while ((r & 3) == 3 && lv < OM_MAX_LEVEL) {
+        lv++;
+        r >>= 2;
+    }
+    return lv;
+}
+
+/* descend to the last node with key < target at every level, tracking the
+ * (count, sum) prefix from head to update[l] */
+static void om_descend(OMap *self, PyObject *target, OMNode **update,
+                       int64_t *pcnt, int64_t *psum) {
+    OMNode *x = self->head;
+    int64_t c = 0, s = 0;
+    for (int l = self->cur_level - 1; l >= 0; l--) {
+        while (x->ln[l].next && om_keycmp(x->ln[l].next->key, target) < 0) {
+            c += x->ln[l].cnt;
+            s += x->ln[l].sum;
+            x = x->ln[l].next;
+        }
+        update[l] = x;
+        pcnt[l] = c;
+        psum[l] = s;
+    }
+    for (int l = self->cur_level; l < OM_MAX_LEVEL; l++) {
+        update[l] = self->head;
+        pcnt[l] = 0;
+        psum[l] = 0;
+    }
+}
+
+static void om_erase_node(OMap *self, OMNode **update, OMNode *node) {
+    for (int l = 0; l < node->level; l++) {
+        update[l]->ln[l].cnt += node->ln[l].cnt - 1;
+        update[l]->ln[l].sum += node->ln[l].sum - node->metric;
+        update[l]->ln[l].next = node->ln[l].next;
+    }
+    for (int l = node->level; l < self->cur_level; l++) {
+        if (update[l]->ln[l].next) {
+            update[l]->ln[l].cnt -= 1;
+            update[l]->ln[l].sum -= node->metric;
+        }
+    }
+    Py_DECREF(node->key);
+    free(node);
+    self->n--;
+}
+
+static PyObject *om_insert(OMap *self, PyObject *args) {
+    PyObject *key;
+    long long metric = 1;
+    if (!PyArg_ParseTuple(args, "S|L", &key, &metric))
+        return NULL;
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    om_descend(self, key, update, pcnt, psum);
+    OMNode *at = update[0]->ln[0].next;
+    if (at && om_keycmp(at->key, key) == 0) {
+        /* metric replace: after the strict-less descent, every tracked
+         * link (update[l], update[l]->next] with next != NULL contains
+         * this node (next is the node itself below its level, a later
+         * node above it) — each gets the delta */
+        int64_t delta = (int64_t)metric - at->metric;
+        if (delta) {
+            at->metric += delta;
+            for (int l = 0; l < self->cur_level; l++)
+                if (update[l]->ln[l].next)
+                    update[l]->ln[l].sum += delta;
+        }
+        Py_RETURN_NONE;
+    }
+    int lv = om_rand_level(self);
+    if (lv > self->cur_level) {
+        for (int l = self->cur_level; l < lv; l++) {
+            update[l] = self->head;
+            pcnt[l] = 0;
+            psum[l] = 0;
+            /* new top level: head's link spans the whole list (set below
+             * for the pass-through fixups to be correct) */
+            self->head->ln[l].next = NULL;
+            self->head->ln[l].cnt = 0;
+            self->head->ln[l].sum = 0;
+        }
+        self->cur_level = lv;
+    }
+    OMNode *nb = om_node_new(key, metric, lv);
+    if (!nb)
+        return PyErr_NoMemory();
+    int64_t r0 = pcnt[0], s0 = psum[0];
+    for (int l = 0; l < lv; l++) {
+        OMNode *next = update[l]->ln[l].next;
+        int64_t oc = update[l]->ln[l].cnt, os = update[l]->ln[l].sum;
+        int64_t d1c = (r0 - pcnt[l]) + 1;          /* (update[l], nb] */
+        int64_t d1s = (s0 - psum[l]) + metric;
+        nb->ln[l].next = next;
+        if (next) {
+            nb->ln[l].cnt = oc - d1c + 1;
+            nb->ln[l].sum = os - d1s + metric;
+        } else {
+            nb->ln[l].cnt = 0;
+            nb->ln[l].sum = 0;
+        }
+        update[l]->ln[l].next = nb;
+        update[l]->ln[l].cnt = d1c;
+        update[l]->ln[l].sum = d1s;
+    }
+    for (int l = lv; l < self->cur_level; l++) {
+        if (update[l]->ln[l].next) {
+            update[l]->ln[l].cnt += 1;
+            update[l]->ln[l].sum += metric;
+        }
+    }
+    self->n++;
+    Py_RETURN_NONE;
+}
+
+static PyObject *om_discard(OMap *self, PyObject *key) {
+    if (!PyBytes_Check(key)) {
+        PyErr_SetString(PyExc_TypeError, "key must be bytes");
+        return NULL;
+    }
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    om_descend(self, key, update, pcnt, psum);
+    OMNode *at = update[0]->ln[0].next;
+    if (at && om_keycmp(at->key, key) == 0) {
+        om_erase_node(self, update, at);
+        Py_RETURN_TRUE;
+    }
+    Py_RETURN_FALSE;
+}
+
+static PyObject *om_rank(OMap *self, PyObject *key) {
+    if (!PyBytes_Check(key)) {
+        PyErr_SetString(PyExc_TypeError, "key must be bytes");
+        return NULL;
+    }
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    om_descend(self, key, update, pcnt, psum);
+    return PyLong_FromLongLong(pcnt[0]); /* keys strictly < key */
+}
+
+static PyObject *om_nth(OMap *self, PyObject *arg) {
+    Py_ssize_t i = PyLong_AsSsize_t(arg);
+    if (i == -1 && PyErr_Occurred())
+        return NULL;
+    if (i < 0 || i >= self->n) {
+        PyErr_SetString(PyExc_IndexError, "IndexedSet.nth out of range");
+        return NULL;
+    }
+    OMNode *x = self->head;
+    int64_t want = i + 1, acc = 0;
+    for (int l = self->cur_level - 1; l >= 0; l--) {
+        while (x->ln[l].next && acc + x->ln[l].cnt <= want) {
+            acc += x->ln[l].cnt;
+            x = x->ln[l].next;
+            if (acc == want) {
+                Py_INCREF(x->key);
+                return x->key;
+            }
+        }
+    }
+    PyErr_SetString(PyExc_RuntimeError, "IndexedSet corrupt");
+    return NULL;
+}
+
+static PyObject *om_range_keys(OMap *self, PyObject *args) {
+    PyObject *lo, *hi;
+    Py_ssize_t limit = 0;
+    int reverse = 0;
+    if (!PyArg_ParseTuple(args, "SS|np", &lo, &hi, &limit, &reverse))
+        return NULL;
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    PyObject *out = PyList_New(0);
+    if (!out)
+        return NULL;
+    if (!reverse) {
+        om_descend(self, lo, update, pcnt, psum);
+        OMNode *x = update[0]->ln[0].next;
+        while (x && om_keycmp(x->key, hi) < 0) {
+            if (PyList_Append(out, x->key) < 0) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            if (limit && PyList_GET_SIZE(out) >= limit)
+                break;
+            x = x->ln[0].next;
+        }
+        return out;
+    }
+    /* reverse: walk the bounded window forward from a rank, then flip */
+    om_descend(self, lo, update, pcnt, psum);
+    int64_t r_lo = pcnt[0];
+    om_descend(self, hi, update, pcnt, psum);
+    int64_t r_hi = pcnt[0];
+    int64_t start = r_lo;
+    if (limit && r_hi - r_lo > limit)
+        start = r_hi - limit;
+    if (start < r_hi) {
+        PyObject *idx = PyLong_FromLongLong(start);
+        if (!idx) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyObject *first = om_nth(self, idx);
+        Py_DECREF(idx);
+        if (!first) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        om_descend(self, first, update, pcnt, psum);
+        Py_DECREF(first);
+        OMNode *x = update[0]->ln[0].next;
+        int64_t todo = r_hi - start;
+        while (x && todo-- > 0) {
+            if (PyList_Append(out, x->key) < 0) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            x = x->ln[0].next;
+        }
+        if (PyList_Reverse(out) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+    }
+    return out;
+}
+
+static PyObject *om_sum_range(OMap *self, PyObject *args) {
+    PyObject *lo, *hi;
+    if (!PyArg_ParseTuple(args, "SS", &lo, &hi))
+        return NULL;
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    om_descend(self, lo, update, pcnt, psum);
+    int64_t c0 = pcnt[0], s0 = psum[0];
+    /* prefix(<lo) must not count a node EQUAL to lo; om_descend is strict-
+     * less, so pcnt[0] is exactly the count of keys < lo */
+    om_descend(self, hi, update, pcnt, psum);
+    return Py_BuildValue("(LL)", (long long)(pcnt[0] - c0),
+                         (long long)(psum[0] - s0));
+}
+
+static PyObject *om_contains(OMap *self, PyObject *key) {
+    OMNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL], psum[OM_MAX_LEVEL];
+    if (!PyBytes_Check(key))
+        Py_RETURN_FALSE;
+    om_descend(self, key, update, pcnt, psum);
+    OMNode *at = update[0]->ln[0].next;
+    if (at && om_keycmp(at->key, key) == 0)
+        Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static Py_ssize_t om_len(PyObject *op) {
+    return ((OMap *)op)->n;
+}
+
+static void om_dealloc(OMap *self) {
+    OMNode *x = self->head->ln[0].next;
+    while (x) {
+        OMNode *nx = x->ln[0].next;
+        Py_DECREF(x->key);
+        free(x);
+        x = nx;
+    }
+    free(self->head);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *om_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+    OMap *self = (OMap *)type->tp_alloc(type, 0);
+    if (!self)
+        return NULL;
+    self->head = om_node_new(NULL, 0, OM_MAX_LEVEL);
+    if (!self->head) {
+        Py_TYPE(self)->tp_free((PyObject *)self);
+        return PyErr_NoMemory();
+    }
+    self->cur_level = 1;
+    self->n = 0;
+    self->rng = 0x9E3779B97F4A7C15ULL;
+    return (PyObject *)self;
+}
+
+static PyMethodDef om_methods[] = {
+    {"insert", (PyCFunction)om_insert, METH_VARARGS,
+     "insert(key, metric=1): add or re-metric a key"},
+    {"discard", (PyCFunction)om_discard, METH_O,
+     "discard(key) -> bool: remove if present"},
+    {"rank", (PyCFunction)om_rank, METH_O,
+     "rank(key) -> number of keys < key (bisect_left)"},
+    {"nth", (PyCFunction)om_nth, METH_O, "nth(i) -> i-th smallest key"},
+    {"range_keys", (PyCFunction)om_range_keys, METH_VARARGS,
+     "range_keys(lo, hi, limit=0, reverse=False) -> [keys in [lo, hi))]"},
+    {"sum_range", (PyCFunction)om_sum_range, METH_VARARGS,
+     "sum_range(lo, hi) -> (count, metric_sum) over [lo, hi)"},
+    {"contains", (PyCFunction)om_contains, METH_O, "membership"},
+    {NULL, NULL, 0, NULL}};
+
+static PySequenceMethods om_as_sequence = {
+    .sq_length = om_len,
+};
+
+static PyTypeObject OMapType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "fdb_native.IndexedSet",
+    .tp_basicsize = sizeof(OMap),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_new = om_new,
+    .tp_dealloc = (destructor)om_dealloc,
+    .tp_methods = om_methods,
+    .tp_as_sequence = &om_as_sequence,
+    .tp_doc = "count+sum-augmented ordered bytes map (flow/IndexedSet.h)",
+};
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
@@ -792,5 +1169,16 @@ static struct PyModuleDef moduledef = {
 
 PyMODINIT_FUNC PyInit_fdb_native(void) {
     crc32c_init();
-    return PyModule_Create(&moduledef);
+    if (PyType_Ready(&OMapType) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m)
+        return NULL;
+    Py_INCREF(&OMapType);
+    if (PyModule_AddObject(m, "IndexedSet", (PyObject *)&OMapType) < 0) {
+        Py_DECREF(&OMapType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
 }

@@ -56,7 +56,12 @@ from foundationdb_tpu.utils.knobs import KNOBS
 
 L = keylib.NUM_LIMBS  # default key limbs (6 data + 1 length; see ConflictShapes.key_bytes)
 _NEG_INT = -(1 << 30)
-NEG = jnp.int32(_NEG_INT)  # "no version" sentinel, below any clamped offset
+# "no version" sentinel, below any clamped offset. A plain host int on
+# purpose: a module-level jnp scalar would initialize the device backend at
+# IMPORT time, which every server role (and any tool importing the client
+# stack) would pay — and hang on, if the accelerator runtime is wedged.
+# jnp expressions promote it exactly like the former device constant.
+NEG = _NEG_INT
 _REBASE_THRESHOLD = 1 << 29
 
 

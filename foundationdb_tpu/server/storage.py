@@ -199,15 +199,21 @@ class StorageServer:
 
     def _on_get_metrics(self, req: GetStorageMetricsRequest, reply):
         """Byte counts + split candidate per range (the byte-sampling feed
-        for shardSplitter, storageserver byteSampleApplySet :2992 — here an
-        exact count over the durable engine, affordable at sim scale)."""
+        for shardSplitter, storageserver byteSampleApplySet :2992): exact
+        counts in O(log n) from the engine's sum-augmented IndexedSet when
+        the engine exposes it (memory engine), full scan otherwise (ssd)."""
         out = []
         for b, e in req.ranges:
-            rows = self.store.get_range(b, e if e is not None else b"\xff" * 40)
-            total = sum(len(k) + len(v) for k, v in rows)
-            split = rows[len(rows) // 2][0] if len(rows) >= 4 else None
-            if split == b:
-                split = None  # a split at the begin boundary is no split
+            hi = e if e is not None else b"\xff" * 40
+            if hasattr(self.store, "bytes_range"):
+                _n, total = self.store.bytes_range(b, hi)
+                split = self.store.split_key(b, hi)
+            else:
+                rows = self.store.get_range(b, hi)
+                total = sum(len(k) + len(v) for k, v in rows)
+                split = rows[len(rows) // 2][0] if len(rows) >= 4 else None
+                if split == b:
+                    split = None  # a split at the begin boundary is no split
             out.append(ShardMetrics(bytes=total, split_key=split))
         reply.send(out)
 

@@ -28,7 +28,10 @@ from foundationdb_tpu.utils.types import (
 
 class VersionedMap:
     def __init__(self, oldest_version: int = 0):
-        self._index: list[bytes] = []  # sorted keys with non-empty chains
+        from foundationdb_tpu.utils.indexedset import make_indexed_set
+        # ordered key index (flow/IndexedSet.h analogue; C skiplist with
+        # O(log n) inserts — bisect lists made every first-write O(n))
+        self._index = make_indexed_set()
         self._chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
         self.oldest_version = oldest_version  # reads below this throw
         self.latest_version = oldest_version
@@ -43,10 +46,8 @@ class VersionedMap:
         if m.type == MutationType.SET_VALUE:
             self._put(m.param1, version, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
-            lo = bisect.bisect_left(self._index, m.param1)
-            hi = bisect.bisect_left(self._index, m.param2)
-            # slice copy: _put may drop fully-cleared keys from the index
-            for key in self._index[lo:hi]:
+            # materialized list: _put may drop fully-cleared keys
+            for key in self._index.range_keys(m.param1, m.param2):
                 if self._latest_value(key) is not None:
                     self._put(key, version, None)
         elif m.type in ATOMIC_OPS:
@@ -67,7 +68,7 @@ class VersionedMap:
             if value is None:
                 return  # clearing an absent key is a no-op
             self._chains[key] = [(version, value)]
-            bisect.insort(self._index, key)
+            self._index.insert(key, 1)
             return
         if chain[-1][0] == version:
             chain[-1] = (version, value)
@@ -119,11 +120,8 @@ class VersionedMap:
         return False
 
     def _iter_keys(self, begin: bytes, end: bytes, reverse: bool) -> Iterator[bytes]:
-        lo = bisect.bisect_left(self._index, begin)
-        hi = bisect.bisect_left(self._index, end)
-        rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
-        for i in rng:
-            yield self._index[i]
+        from foundationdb_tpu.utils.indexedset import iter_range
+        return iter_range(self._index, begin, end, reverse)
 
     def _check_version(self, version: int):
         if version < self.oldest_version:
@@ -146,8 +144,7 @@ class VersionedMap:
                 dead.append(key)
         for key in dead:
             del self._chains[key]
-            i = bisect.bisect_left(self._index, key)
-            del self._index[i]
+            self._index.discard(key)
 
     def rollback(self, version: int):
         """Discard versions > `version` (storageserver.actor.cpp:2211): a
@@ -165,8 +162,7 @@ class VersionedMap:
                 dead.append(key)
         for key in dead:
             del self._chains[key]
-            i = bisect.bisect_left(self._index, key)
-            del self._index[i]
+            self._index.discard(key)
         self.latest_version = version
 
     # -- introspection --

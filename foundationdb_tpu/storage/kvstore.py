@@ -19,7 +19,6 @@ deterministic scheduling point, so simulation determinism is preserved).
 
 from __future__ import annotations
 
-import bisect
 from typing import Iterable, Protocol
 
 from foundationdb_tpu.storage.diskqueue import DiskQueue
@@ -60,9 +59,12 @@ class MemoryKeyValueStore:
     SNAPSHOT_OPS = 10_000  # ops between snapshots (KNOB-ish; small for sim)
 
     def __init__(self, file0, file1):
+        from foundationdb_tpu.utils.indexedset import make_indexed_set
         self.queue = DiskQueue(file0, file1)
         self._data: dict[bytes, bytes] = {}
-        self._index: list[bytes] = []
+        # size-augmented ordered index (flow/IndexedSet.h): O(log n)
+        # inserts and O(log n) byte sums over ranges (shard metrics)
+        self._index = make_indexed_set()
         self._meta: dict[str, bytes] = {}
         self._pending: list[tuple] = []
         self._ops_since_snapshot = 0
@@ -85,16 +87,13 @@ class MemoryKeyValueStore:
         return self._meta.get(key)
 
     def _apply_set(self, key: bytes, value: bytes):
-        if key not in self._data:
-            bisect.insort(self._index, key)
+        self._index.insert(key, len(key) + len(value))
         self._data[key] = value
 
     def _apply_clear(self, begin: bytes, end: bytes):
-        lo = bisect.bisect_left(self._index, begin)
-        hi = bisect.bisect_left(self._index, end)
-        for k in self._index[lo:hi]:
+        for k in self._index.range_keys(begin, end):
             del self._data[k]
-        del self._index[lo:hi]
+            self._index.discard(k)
 
     # -- reads (always from RAM, like the reference memory engine) --
 
@@ -103,14 +102,25 @@ class MemoryKeyValueStore:
 
     def get_range(self, begin: bytes, end: bytes, limit: int = -1,
                   reverse: bool = False) -> list[tuple[bytes, bytes]]:
-        lo = bisect.bisect_left(self._index, begin)
-        hi = bisect.bisect_left(self._index, end)
-        keys = self._index[lo:hi]
-        if reverse:
-            keys = keys[::-1]
-        if limit >= 0:
-            keys = keys[:limit]
+        if limit == 0:
+            return []  # limit semantics: 0 rows; unlimited is limit < 0
+        keys = self._index.range_keys(begin, end, max(limit, 0), reverse)
         return [(k, self._data[k]) for k in keys]
+
+    def bytes_range(self, begin: bytes, end: bytes) -> tuple[int, int]:
+        """(row count, key+value bytes) over [begin, end) in O(log n) —
+        the augmented-sum read shard metrics are built on (the reference's
+        byteSample serves the same query, storageserver byteSampleApplySet;
+        here the index sum is exact rather than sampled)."""
+        return self._index.sum_range(begin, end)
+
+    def split_key(self, begin: bytes, end: bytes) -> bytes | None:
+        """Median-by-count split candidate in O(log n)."""
+        n, _b = self._index.sum_range(begin, end)
+        if n < 4:
+            return None
+        k = self._index.nth(self._index.rank(begin) + n // 2)
+        return None if k == begin else k
 
     # -- durability --
 
@@ -134,8 +144,9 @@ class MemoryKeyValueStore:
         self._ops_since_snapshot = 0
 
     def recover(self) -> None:
+        from foundationdb_tpu.utils.indexedset import make_indexed_set
         self._data.clear()
-        self._index.clear()
+        self._index = make_indexed_set()
         self._meta.clear()
         self._pending = []
         for _seq, payload in self.queue.recover():
@@ -157,7 +168,8 @@ class MemoryKeyValueStore:
                         del self._data[k]
                 elif op[0] == _OP_META:
                     self._meta[op[1]] = op[2]
-        self._index = sorted(self._data)
+        for k, v in self._data.items():
+            self._index.insert(k, len(k) + len(v))
         self._ops_since_snapshot = 0
 
 

@@ -76,3 +76,64 @@ def test_rng_determinism():
     a, b = DeterministicRandom(42), DeterministicRandom(42)
     assert [a.randint(0, 100) for _ in range(10)] == [b.randint(0, 100) for _ in range(10)]
     assert a.fork().random() == b.fork().random()
+
+
+class TestIndexedSet:
+    """flow/IndexedSet.h parity: the C skiplist and the Python fallback make
+    identical decisions (insert/discard/rank/nth/ranges/sums), and the
+    augmented sums answer range metrics in O(log n)."""
+
+    def _pair(self):
+        from foundationdb_tpu.utils.indexedset import (
+            PyIndexedSet, make_indexed_set)
+        return make_indexed_set(), PyIndexedSet()
+
+    def test_fuzz_parity_with_python_fallback(self):
+        import random
+        s, p = self._pair()
+        rng = random.Random(99)
+        for _ in range(3000):
+            op = rng.random()
+            k = b"k%05d" % rng.randrange(900)
+            if op < 0.55:
+                m = rng.randrange(1, 50)
+                s.insert(k, m)
+                p.insert(k, m)
+            elif op < 0.75:
+                assert s.discard(k) == p.discard(k)
+            else:
+                lo = b"k%05d" % rng.randrange(900)
+                hi = b"k%05d" % rng.randrange(900)
+                if lo > hi:
+                    lo, hi = hi, lo
+                assert s.rank(lo) == p.rank(lo)
+                assert tuple(s.sum_range(lo, hi)) == tuple(p.sum_range(lo, hi))
+                assert s.range_keys(lo, hi, 7, False) == \
+                    p.range_keys(lo, hi, 7, False)
+                assert s.range_keys(lo, hi, 7, True) == \
+                    p.range_keys(lo, hi, 7, True)
+        assert len(s) == len(p)
+        for i in (0, len(p) // 3, len(p) - 1):
+            if 0 <= i < len(p):
+                assert s.nth(i) == p.nth(i)
+
+    def test_metric_replace_updates_sums(self):
+        s, _ = self._pair()
+        s.insert(b"a", 10)
+        s.insert(b"b", 20)
+        s.insert(b"c", 30)
+        assert tuple(s.sum_range(b"a", b"d")) == (3, 60)
+        s.insert(b"b", 5)  # re-metric
+        assert tuple(s.sum_range(b"a", b"d")) == (3, 45)
+        assert tuple(s.sum_range(b"b", b"c")) == (1, 5)
+
+    def test_lazy_iteration_matches_range(self):
+        from foundationdb_tpu.utils.indexedset import iter_range
+        s, _ = self._pair()
+        for i in range(500):
+            s.insert(b"%05d" % i, 1)
+        assert list(iter_range(s, b"00100", b"00400", chunk=13)) == \
+            [b"%05d" % i for i in range(100, 400)]
+        assert list(iter_range(s, b"00100", b"00400", reverse=True,
+                               chunk=7)) == \
+            [b"%05d" % i for i in range(399, 99, -1)]
