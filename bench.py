@@ -146,15 +146,22 @@ def run_e2e() -> dict:
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_e2e.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    try:
-        proc = subprocess.run(
-            [sys.executable, script, "oracle", "device"],
-            capture_output=True, text=True, timeout=1800, env=env)
-        if proc.returncode != 0:
-            return {"error": proc.stderr[-800:]}
-        return json.loads(proc.stdout)
-    except Exception as e:  # noqa: BLE001
-        return {"error": f"{type(e).__name__}: {e}"}
+    out = {}
+    # one subprocess per backend: a hung/failed device run (e.g. the remote
+    # accelerator refusing a second client) must not take the oracle
+    # numbers down with it
+    for backend in ("oracle", "device"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, backend],
+                capture_output=True, text=True, timeout=1500, env=env)
+            if proc.returncode != 0:
+                out[backend] = {"error": proc.stderr[-600:]}
+            else:
+                out[backend] = json.loads(proc.stdout)
+        except Exception as e:  # noqa: BLE001
+            out[backend] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def run_kernel(T: int, n_batches: int, chunk: int) -> dict:

@@ -155,9 +155,33 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2):
     procs = [_spawn_server(core_spec, core_env)]
     for spec in proxy_specs + storage_specs:
         procs.append(_spawn_server(spec, env))
+    # bounded boot: a device-backend core can hang for minutes attaching a
+    # remote accelerator that has not released its previous client; kill
+    # the whole boot instead of stalling the bench forever
+    deadline = time.monotonic() + (600 if backend != "oracle" else 120)
+    import selectors
     for p in procs:
-        line = p.stdout.readline().decode()
-        assert line.startswith("ready"), line
+        sel = selectors.DefaultSelector()
+        sel.register(p.stdout, selectors.EVENT_READ)
+        buf = b""
+        while b"\n" not in buf:
+            budget = deadline - time.monotonic()
+            if budget <= 0 or not sel.select(timeout=min(budget, 5.0)):
+                if time.monotonic() >= deadline:
+                    for q in procs:
+                        q.kill()
+                    raise TimeoutError(
+                        f"server {p.args[-1][:60]}... did not boot "
+                        f"(accelerator attach hung?)")
+                continue
+            chunk = p.stdout.read1(4096)
+            if not chunk:
+                for q in procs:
+                    q.kill()
+                raise RuntimeError("server died during boot")
+            buf += chunk
+        sel.close()
+        assert buf.startswith(b"ready"), buf[:120]
     return procs, p_proxies, boundaries, p_storages
 
 

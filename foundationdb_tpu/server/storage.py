@@ -497,21 +497,35 @@ class StorageServer:
     async def _get_values(self, req, reply):
         """Batched point reads (STORAGE_GET_VALUES): one version wait for
         the whole batch, per-key MVCC lookups, per-key errors in the reply
-        so one moved key doesn't fail its neighbors."""
+        so one moved key doesn't fail its neighbors.
+
+        The lookup loop reads the versioned map's internals directly — this
+        handler is the host read path's hottest loop, and the wrapper stack
+        (get -> _check_version -> _value_at) costs more than the bisect."""
+        from bisect import bisect_right
+
         from foundationdb_tpu.server.interfaces import GetValuesReply
         try:
             await self._wait_for_version(max(v for _k, v in req.reads))
         except FDBError as e:
             reply.send_error(e)  # retryable as a unit (future_version etc.)
             return
+        chains = self.data._chains
+        oldest = self.data.oldest_version
+        serve_all = self.shard_ranges is None
         out = []
         for k, v in req.reads:
-            if not self._owns_key(k):
+            if not (serve_all or self._owns_key(k)):
                 out.append((1, "wrong_shard_server"))
-            elif v < self.data.oldest_version:
+            elif v < oldest:
                 out.append((1, "transaction_too_old"))
             else:
-                out.append((0, self.data.get(k, v)))
+                c = chains.get(k)
+                if c is None:
+                    out.append((0, None))
+                else:
+                    i = bisect_right(c[0], v) - 1
+                    out.append((0, c[1][i] if i >= 0 else None))
         reply.send(GetValuesReply(results=out))
 
     # selector resolution (storageserver.actor.cpp findKey)

@@ -6,8 +6,9 @@ pruned as versions become durable (storageserver.actor.cpp:2358 update,
 :2633 updateStorage).
 
 TPU-host design: instead of a persistent tree we keep, per key, an ascending
-version chain of (version, value-or-tombstone), plus one sorted key index for
-range reads. Mutations arrive strictly in version order (the TLog ingestion
+version chain as PARALLEL lists (versions, values) — a read bisects the
+C-typed int list directly (no per-entry key function) — plus one sorted key
+index for range reads. Mutations arrive strictly in version order (the TLog ingestion
 contract), so chain appends are O(1) amortized and a read at version v binary
 searches the chain. ClearRange writes tombstones onto every key live at that
 version (chains preserve older versions for concurrent readers).
@@ -32,7 +33,9 @@ class VersionedMap:
         # ordered key index (flow/IndexedSet.h analogue; C skiplist with
         # O(log n) inserts — bisect lists made every first-write O(n))
         self._index = make_indexed_set()
-        self._chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        # key -> ([versions ascending], [values]); parallel lists so the
+        # hot read path is one C bisect over ints
+        self._chains: dict[bytes, tuple[list[int], list[bytes | None]]] = {}
         self.oldest_version = oldest_version  # reads below this throw
         self.latest_version = oldest_version
 
@@ -60,32 +63,36 @@ class VersionedMap:
 
     def _latest_value(self, key: bytes) -> bytes | None:
         chain = self._chains.get(key)
-        return chain[-1][1] if chain else None
+        return chain[1][-1] if chain else None
 
     def _put(self, key: bytes, version: int, value: bytes | None):
         chain = self._chains.get(key)
         if chain is None:
             if value is None:
                 return  # clearing an absent key is a no-op
-            self._chains[key] = [(version, value)]
+            self._chains[key] = ([version], [value])
             self._index.insert(key, 1)
             return
-        if chain[-1][0] == version:
-            chain[-1] = (version, value)
+        versions, values = chain
+        if versions[-1] == version:
+            values[-1] = value
         else:
-            chain.append((version, value))
+            versions.append(version)
+            values.append(value)
 
     # -- read path --
 
     def _value_at(self, key: bytes, version: int) -> bytes | None:
         chain = self._chains.get(key)
-        if not chain:
+        if chain is None:
             return None
-        # rightmost entry with entry.version <= version
-        i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+        # rightmost entry with entry.version <= version: one C bisect over
+        # the int list (a key= callable here was the storage read path's
+        # single hottest line)
+        i = bisect.bisect_right(chain[0], version) - 1
         if i < 0:
             return None
-        return chain[i][1]
+        return chain[1][i]
 
     def get(self, key: bytes, version: int) -> bytes | None:
         self._check_version(version)
@@ -136,11 +143,12 @@ class VersionedMap:
             return
         self.oldest_version = version
         dead: list[bytes] = []
-        for key, chain in self._chains.items():
-            i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+        for key, (versions, values) in self._chains.items():
+            i = bisect.bisect_right(versions, version) - 1
             if i > 0:
-                del chain[:i]
-            if len(chain) == 1 and chain[0][1] is None:
+                del versions[:i]
+                del values[:i]
+            if len(versions) == 1 and values[0] is None:
                 dead.append(key)
         for key in dead:
             del self._chains[key]
@@ -154,11 +162,12 @@ class VersionedMap:
         if version >= self.latest_version:
             return
         dead: list[bytes] = []
-        for key, chain in self._chains.items():
-            i = bisect.bisect_right(chain, version, key=lambda e: e[0])
-            if i < len(chain):
-                del chain[i:]
-            if not chain:
+        for key, (versions, values) in self._chains.items():
+            i = bisect.bisect_right(versions, version)
+            if i < len(versions):
+                del versions[i:]
+                del values[i:]
+            if not versions:
                 dead.append(key)
         for key in dead:
             del self._chains[key]
@@ -171,5 +180,5 @@ class VersionedMap:
         return len(self._index)
 
     def byte_size(self) -> int:
-        return sum(len(k) + sum(len(v or b"") + 16 for _, v in c)
+        return sum(len(k) + sum(len(v or b"") + 16 for v in c[1])
                    for k, c in self._chains.items())
