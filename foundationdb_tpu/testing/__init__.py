@@ -1,5 +1,7 @@
 from foundationdb_tpu.testing.workloads import (  # noqa: F401
     ApiCorrectnessWorkload, AtomicOpsWorkload, AttritionWorkload,
     ConflictRangeWorkload, ConsistencyCheckWorkload, CycleWorkload,
-    RandomCloggingWorkload, RandomMoveKeysWorkload, SwizzleCloggingWorkload,
-    WriteDuringReadWorkload, run_spec)
+    IncrementWorkload, RandomCloggingWorkload, RandomMoveKeysWorkload,
+    SelectorCorrectnessWorkload, SwizzleCloggingWorkload,
+    VersionStampWorkload, WatchesWorkload, WriteDuringReadWorkload,
+    run_spec)

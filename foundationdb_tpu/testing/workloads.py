@@ -774,3 +774,224 @@ class RandomMoveKeysWorkload(Workload):
 
     async def check(self, db):
         assert self.moves > 0, "no shard was ever moved"
+
+
+class IncrementWorkload(Workload):
+    """Atomic counter increments with exact accounting
+    (workloads/Increment.actor.cpp): every CONFIRMED transaction added
+    exactly 1 to one of K counters; a per-transaction marker resolves
+    commit_unknown_result, so at check() the counter total equals the
+    confirmed count exactly — lost or doubled increments both fail."""
+
+    name = "Increment"
+
+    def __init__(self, n_counters: int = 5, prefix: bytes = b"incr/"):
+        self.k = n_counters
+        self.prefix = prefix
+        self.confirmed = 0
+
+    async def start(self, db):
+        from foundationdb_tpu.utils.types import MutationType
+        it = 0
+        while self._time_left():
+            it += 1
+            c = self.rng.randint(0, self.k - 1)
+            marker = self.prefix + b"__m__"
+            token = b"t%08d" % it
+
+            async def fn(tr, c=c, token=token):
+                tr.atomic_op(MutationType.ADD_VALUE,
+                             self.prefix + b"c%02d" % c,
+                             (1).to_bytes(8, "little"))
+                tr.set(marker, token)
+                return True
+            try:
+                if await self._commit_resolved(db, fn, marker, token):
+                    self.confirmed += 1
+            except FDBError:
+                pass
+            await self.cluster.loop.delay(0.01 * self.rng.random())
+
+    async def check(self, db):
+        assert self.confirmed > 0
+        async def rd(tr):
+            total = 0
+            for c in range(self.k):
+                v = await tr.get(self.prefix + b"c%02d" % c)
+                total += int.from_bytes(v or b"", "little")
+            return total
+        total = await db.transact(rd, max_retries=1000)
+        assert total == self.confirmed, \
+            (f"increment accounting broken: counters sum {total}, "
+             f"confirmed {self.confirmed}")
+
+
+class SelectorCorrectnessWorkload(Workload):
+    """Key-selector resolution vs a host model
+    (workloads/SelectorCorrectness.actor.cpp): a FIXED key set, then random
+    (or_equal, offset) selectors resolved by the database must match the
+    model's walk over the sorted keys, including selectors inside
+    uncommitted-write overlays."""
+
+    name = "SelectorCorrectness"
+
+    def __init__(self, n_keys: int = 20, prefix: bytes = b"sel/"):
+        self.n = n_keys
+        self.prefix = prefix
+        self.checked = 0
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%04d" % i
+
+    async def setup(self, db):
+        async def fn(tr):
+            for i in range(0, self.n, 2):  # only even keys exist
+                tr.set(self.key(i), b"v")
+        await db.transact(fn)
+
+    def _model_resolve(self, keys, base, or_equal, offset):
+        """The selector contract: start at the first key > base (or >= if
+        not or_equal... the reference defines or_equal on the BASE), then
+        move offset-1 forward / -offset back (workloads SelectorCorrectness
+        uses the same arithmetic)."""
+        import bisect
+        if offset >= 1:
+            start = bisect.bisect_right(keys, base) if or_equal \
+                else bisect.bisect_left(keys, base)
+            i = start + (offset - 1)
+            if i < len(keys):
+                return keys[i]
+            return b"<end>"
+        start = bisect.bisect_right(keys, base) if or_equal \
+            else bisect.bisect_left(keys, base)
+        i = start - (1 - offset)
+        if i >= 0:
+            return keys[i]
+        return b"<begin>"
+
+    async def start(self, db):
+        from foundationdb_tpu.server.interfaces import KeySelector
+        keys = [self.key(i) for i in range(0, self.n, 2)]
+        while self._time_left():
+            base = self.key(self.rng.randint(0, self.n - 1))
+            or_equal = self.rng.coinflip(0.5)
+            offset = self.rng.randint(-2, 3)
+            if offset == 0:
+                offset = 1
+
+            async def fn(tr, base=base, or_equal=or_equal, offset=offset):
+                got = await tr.get_key(KeySelector(key=base,
+                                                  or_equal=or_equal,
+                                                  offset=offset))
+                if not got.startswith(self.prefix):
+                    got = b"<end>" if got > self.prefix else b"<begin>"
+                want = self._model_resolve(keys, base, or_equal, offset)
+                assert got == want, \
+                    (f"selector({base}, or_equal={or_equal}, "
+                     f"offset={offset}) = {got}, model {want}")
+            try:
+                tr = db.create_transaction()
+                await fn(tr)
+                tr.reset()
+                self.checked += 1
+            except FDBError:
+                pass
+            await self.cluster.loop.delay(0.01 * self.rng.random())
+
+    async def check(self, db):
+        assert self.checked > 10, f"only {self.checked} selectors checked"
+
+
+class WatchesWorkload(Workload):
+    """Watch semantics (workloads/Watches.actor.cpp): a watch on a key
+    resolves when (and only when) the value changes; a watch armed on the
+    CURRENT value does not fire spuriously."""
+
+    name = "Watches"
+
+    def __init__(self, prefix: bytes = b"watch/"):
+        self.prefix = prefix
+        self.fired = 0
+
+    async def start(self, db):
+        loop = self.cluster.loop
+        it = 0
+        while self._time_left():
+            it += 1
+            k = self.prefix + b"%02d" % self.rng.randint(0, 4)
+            new_val = b"w%06d" % it
+
+            # arm the watch (watch() registers at current value)
+            tr = db.create_transaction()
+            try:
+                fut = await tr.watch(k)
+            except FDBError:
+                await loop.delay(0.2)
+                continue
+
+            async def write(tr2, k=k, new_val=new_val):
+                tr2.set(k, new_val)
+            try:
+                await db.transact(write, max_retries=500)
+            except FDBError:
+                continue
+            try:
+                await loop.timeout(fut, 15.0)
+                self.fired += 1
+            except FDBError:
+                pass  # watch lost to a recovery: the client re-arms
+            await loop.delay(0.05 * self.rng.random())
+
+    async def check(self, db):
+        assert self.fired > 3, f"only {self.fired} watches fired"
+
+
+class VersionStampWorkload(Workload):
+    """Versionstamped keys (workloads/VersionStamp.actor.cpp): stamped keys
+    materialize with the COMMIT version big-endian in the placeholder, so
+    they sort in commit order and decode back to the version the commit
+    reported."""
+
+    name = "VersionStamp"
+
+    def __init__(self, prefix: bytes = b"vs/"):
+        self.prefix = prefix
+        self.stamps: list[tuple[int, bytes]] = []  # (committed_version, tag)
+
+    async def start(self, db):
+        from foundationdb_tpu.utils.types import MutationType
+        it = 0
+        while self._time_left():
+            it += 1
+            tag = b"%06d" % it
+            body = self.prefix + b"\x00" * 10
+            key = body + (len(self.prefix)).to_bytes(4, "little")
+            tr = db.create_transaction()
+            try:
+                tr.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key, tag)
+                await tr.commit()
+                self.stamps.append((tr.committed_version, tag))
+            except FDBError:
+                pass
+            await self.cluster.loop.delay(0.02 * self.rng.random())
+
+    async def check(self, db):
+        assert len(self.stamps) > 5
+        async def rd(tr):
+            return await tr.get_range(self.prefix, self.prefix + b"\xff",
+                                      limit=100_000)
+        rows = await db.transact(rd, max_retries=1000)
+        by_tag = {}
+        for k, v in rows:
+            stamp = k[len(self.prefix):]
+            version = int.from_bytes(stamp[:8], "big")
+            by_tag.setdefault(v, []).append(version)
+        versions_in_key_order = [
+            int.from_bytes(k[len(self.prefix):][:8], "big") for k, _v in rows]
+        assert versions_in_key_order == sorted(versions_in_key_order), \
+            "stamped keys not in commit order"
+        for committed, tag in self.stamps:
+            assert tag in by_tag, f"stamped row for {tag} missing"
+            assert committed in by_tag[tag], \
+                (f"stamp for {tag}: committed_version {committed} not in "
+                 f"{by_tag[tag]} (stamp != reported commit version)")

@@ -87,3 +87,56 @@ def test_capi_surface_and_bindingtester(real_cluster):
         fdb_c.fdb_stop_network()
         net_thread.join(timeout=10)
         fdb_c._reset_for_tests()
+
+
+def test_multiversion_client_selection():
+    """MultiVersionApi selection rules (MultiVersionTransaction.actor.cpp):
+    registration gates, most-compatible-library election, unsupported
+    versions rejected, disable option pinning the local client."""
+    import types
+
+    from foundationdb_tpu.bindings import fdb_c
+    from foundationdb_tpu.bindings.multiversion import MultiVersionApi
+
+    def fake_client(max_api):
+        m = types.SimpleNamespace()
+        m.fdb_get_max_api_version = lambda: max_api
+        m.fdb_select_api_version = lambda v: 0 if v <= max_api else 1
+        m.fdb_create_database = lambda cluster: (0, ("db", max_api))
+        return m
+
+    api = MultiVersionApi()
+    assert api.add_external_client("v700", fake_client(700)) == 0
+    assert api.add_external_client("v520", fake_client(520)) == 0
+    assert api.add_external_client("bogus", object()) != 0  # no surface
+    # version above every library -> rejected
+    assert api.fdb_select_api_version(800) != 0
+    # 600 fits v700 and the local 610 library but NOT v520: the election
+    # picks the most compatible (smallest max >= 600) = local 610
+    fdb_c._reset_for_tests()
+    assert api.fdb_select_api_version(600) == 0
+    assert api.active_client is fdb_c
+    # re-select with a different version fails; same version is idempotent
+    assert api.fdb_select_api_version(520) != 0
+    assert api.fdb_select_api_version(600) == 0
+    # surface delegation reaches the active client
+    assert api.fdb_get_max_api_version() == fdb_c.HEADER_API_VERSION
+
+    # a 500-level request elects the v520 library over local/700
+    api2 = MultiVersionApi()
+    api2.add_external_client("v700", fake_client(700))
+    api2.add_external_client("v520", fake_client(520))
+    fdb_c._reset_for_tests()
+    assert api2.fdb_select_api_version(500) == 0
+    assert api2.fdb_get_max_api_version() == 520
+    err, db = api2.fdb_create_database({})
+    assert (err, db) == (0, ("db", 520))
+
+    # disable option pins the local client regardless of externals
+    api3 = MultiVersionApi()
+    api3.add_external_client("v520", fake_client(520))
+    assert api3.disable_multi_version_client_api() == 0
+    fdb_c._reset_for_tests()
+    assert api3.fdb_select_api_version(500) == 0
+    assert api3.active_client is fdb_c
+    fdb_c._reset_for_tests()

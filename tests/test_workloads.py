@@ -61,3 +61,33 @@ def test_random_move_keys_with_cycle_and_faults():
              duration=45.0, buggify=False, n_replicas=2,
              n_storage_workers=4)
     assert w.moves > 0
+
+
+def test_increment_with_clogging():
+    from foundationdb_tpu.testing import IncrementWorkload
+    w = IncrementWorkload()
+    run_spec(66, workloads=[w, RandomCloggingWorkload()], duration=35.0,
+             buggify=False)
+    assert w.confirmed > 10
+
+
+def test_selector_correctness_with_clogging():
+    from foundationdb_tpu.testing import SelectorCorrectnessWorkload
+    w = SelectorCorrectnessWorkload()
+    run_spec(67, workloads=[w, RandomCloggingWorkload()], duration=30.0,
+             buggify=False)
+
+
+def test_watches_with_clogging():
+    from foundationdb_tpu.testing import WatchesWorkload
+    w = WatchesWorkload()
+    run_spec(68, workloads=[w, RandomCloggingWorkload()], duration=35.0,
+             buggify=False)
+    assert w.fired > 3
+
+
+def test_versionstamp_workload():
+    from foundationdb_tpu.testing import VersionStampWorkload
+    w = VersionStampWorkload()
+    run_spec(69, workloads=[w], duration=25.0, buggify=False)
+    assert len(w.stamps) > 5
