@@ -135,7 +135,7 @@ def _encode_batches(n_batches: int, seed: int, version0: int):
     return batch
 
 
-def run_e2e() -> dict:
+def run_e2e(accelerator_ok: bool = True) -> dict:
     """Run the end-to-end bench for BOTH conflict backends in a SUBPROCESS,
     before this process initializes jax: the device-backend e2e gives its
     txn server the accelerator, which must not already be held here (one
@@ -146,6 +146,10 @@ def run_e2e() -> dict:
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_e2e.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if not accelerator_ok:
+        # the device-backend e2e still exercises the device-engine serving
+        # path, on the CPU backend — reported as such
+        env["FDBTPU_E2E_FORCE_CPU"] = "1"
     out = {}
     # one subprocess per backend: a hung/failed device run (e.g. the remote
     # accelerator refusing a second client) must not take the oracle
@@ -164,7 +168,8 @@ def run_e2e() -> dict:
     return out
 
 
-def run_kernel(T: int, n_batches: int, chunk: int) -> dict:
+def run_kernel(T: int, n_batches: int, chunk: int,
+               capacity: int | None = None) -> dict:
     """One timed kernel measurement at `T` txns/batch (see module doc)."""
     global TXNS_PER_BATCH
     import jax
@@ -177,10 +182,13 @@ def run_kernel(T: int, n_batches: int, chunk: int) -> dict:
         ConflictShapes, _compiled_scan, init_state)
     from foundationdb_tpu.utils.knobs import KNOBS
 
+    from foundationdb_tpu.utils.jaxenv import ensure_platform_honored
+    ensure_platform_honored()
     TXNS_PER_BATCH = T  # _encode_batches reads it
     # strided: 1 read + 1 write per txn, the skipListTest shape — the
     # range->txn map compiles to reshapes instead of per-eval scatters
-    shapes = ConflictShapes(capacity=CAPACITY, txns=T, reads=T, writes=T,
+    shapes = ConflictShapes(capacity=capacity or CAPACITY, txns=T,
+                            reads=T, writes=T,
                             key_bytes=KEY_BYTES, strided=True)
     scan = _compiled_scan(shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
@@ -227,26 +235,58 @@ def run_kernel(T: int, n_batches: int, chunk: int) -> dict:
     }
 
 
+def probe_accelerator(timeout: float = 180.0) -> bool:
+    """Can a fresh process attach the accelerator at all? A wedged remote
+    runtime hangs the attach indefinitely; probing once in a throwaway
+    subprocess lets every later stage choose CPU up front instead of each
+    burning its own watchdog."""
+    import subprocess
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ))
+        return proc.returncode == 0 and proc.stdout.strip() not in ("", "cpu")
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def run_kernel_watchdogged(T: int, n_batches: int, chunk: int,
-                           timeout: float = 1500.0) -> dict:
+                           timeout: float = 900.0,
+                           accelerator_ok: bool = True) -> dict:
     """run_kernel in a SUBPROCESS with a deadline, falling back to the CPU
     backend on failure: a wedged remote accelerator runtime (or a hung
     attach) must degrade the measurement, never hang or sink the bench."""
     import subprocess
     import sys
     script = os.path.abspath(__file__)
-    for env_extra, label in (({}, "default"), ({"JAX_PLATFORMS": "cpu"},
-                                               "cpu-fallback")):
+    attempts = (({}, "default"), ({"JAX_PLATFORMS": "cpu"}, "cpu-fallback"))
+    if not accelerator_ok:
+        attempts = (({"JAX_PLATFORMS": "cpu"}, "cpu-fallback"),)
+    for env_extra, label in attempts:
         env = dict(os.environ, **env_extra)
+        kT, kn, kc = T, n_batches, chunk
+        if label == "cpu-fallback":
+            # an emergency measurement, not the headline: the full-size scan
+            # (2^18-capacity sorts x hundreds of batches) is hopeless on one
+            # CPU core — shrink to something that finishes and mark it
+            kT, kn, kc = min(T, 512), 10, 5
         try:
-            proc = subprocess.run(
-                [sys.executable, script, "--kernel", str(T),
-                 str(n_batches), str(chunk)],
-                capture_output=True, text=True, timeout=timeout, env=env)
+            cmd = [sys.executable, script, "--kernel", str(kT),
+                   str(kn), str(kc)]
+            if label == "cpu-fallback":
+                cmd.append(str(1 << 14))  # capacity shrinks with the load
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout, env=env)
             if proc.returncode == 0:
                 out = json.loads(proc.stdout.strip().splitlines()[-1])
                 if label != "default":
                     out["backend_fallback"] = label
+                    if (kT, kn, kc) != (T, n_batches, chunk):
+                        out["scaled_down_from"] = {"txns_per_batch": T,
+                                                   "batches": n_batches}
                 return out
             err = proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
@@ -256,20 +296,24 @@ def run_kernel_watchdogged(T: int, n_batches: int, chunk: int,
 
 
 def main():
+    acc_ok = probe_accelerator()
     # e2e FIRST (and in subprocesses): the parent must not hold the TPU yet
     e2e = None
     if os.environ.get("FDB_TPU_BENCH_E2E", "1") != "0":
-        e2e = run_e2e()
+        e2e = run_e2e(acc_ok)
 
-    r16 = run_kernel_watchdogged(16384, N_BATCHES, CHUNK)
+    r16 = run_kernel_watchdogged(16384, N_BATCHES, CHUNK,
+                                 accelerator_ok=acc_ok)
     # the 32768-point (round-3 gate: >= 1.5x at the doubled batch size)
-    r32 = run_kernel_watchdogged(32768, 100, 50)
+    r32 = run_kernel_watchdogged(32768, 100, 50, accelerator_ok=acc_ok)
     out = {
         "metric": "resolver_conflict_txns_per_sec",
         "unit": "txns/s",
         **r16,
         "batch_32768": r32,
     }
+    if not acc_ok:
+        out["accelerator_unavailable"] = True
     # end-to-end pipeline numbers (real TCP transport, separate server
     # processes, concurrent multi-process clients — BASELINE.md methodology
     # at a saturating concurrency; ran before the kernel bench, see
@@ -283,7 +327,8 @@ def main():
 if __name__ == "__main__":
     import sys
     if len(sys.argv) >= 5 and sys.argv[1] == "--kernel":
+        cap = int(sys.argv[5]) if len(sys.argv) > 5 else None
         print(json.dumps(run_kernel(int(sys.argv[2]), int(sys.argv[3]),
-                                    int(sys.argv[4]))))
+                                    int(sys.argv[4]), capacity=cap)))
         sys.exit(0)
     main()

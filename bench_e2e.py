@@ -146,7 +146,7 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2):
     # persistent compile cache makes the boot-time warmup compile a
     # once-per-machine cost.
     core_env = dict(env)
-    if backend != "oracle":
+    if backend != "oracle" and not os.environ.get("FDBTPU_E2E_FORCE_CPU"):
         core_env.pop("JAX_PLATFORMS", None)
         core_env.setdefault("JAX_COMPILATION_CACHE_DIR",
                             "/tmp/fdb_tpu_jax_cache")
@@ -302,6 +302,9 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
     report: dict = {"clients": clients, "conflict_backend": backend,
                     "topology": {"proxies": n_proxies, "storage": n_storage,
                                  "client_procs": n_client_procs}}
+    if backend != "oracle" and os.environ.get("FDBTPU_E2E_FORCE_CPU"):
+        report["accelerator"] = "cpu-fallback"
+
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(_SELF))
     try:

@@ -861,6 +861,8 @@ class DeviceConflictSet:
                  reads_per_txn: int | None = None, writes_per_txn: int | None = None,
                  oldest_version: int = 0, key_bytes: int | None = None,
                  strided: bool = False):
+        from foundationdb_tpu.utils.jaxenv import ensure_platform_honored
+        ensure_platform_honored()
         self.shapes = _resolve_shapes(capacity, txns, reads_per_txn,
                                       writes_per_txn, key_bytes, strided)
         self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)

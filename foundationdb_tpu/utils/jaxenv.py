@@ -1,0 +1,25 @@
+"""Honor JAX_PLATFORMS even when a PJRT plugin overrides it.
+
+The accelerator plugin registered at interpreter start may set
+jax_platforms programmatically, which SILENTLY overrides the JAX_PLATFORMS
+environment variable — a process launched with JAX_PLATFORMS=cpu can still
+try to attach the remote accelerator (and hang on it if the runtime is
+wedged). Every entry point that constructs a device engine calls
+ensure_platform_honored() first, re-asserting the operator's choice into
+the config before any backend initialization.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform_honored() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001 — backend already initialized: too late
+        pass
