@@ -852,12 +852,17 @@ class ClusterController:
                     or not getattr(self, "_initial_meta_done", False):
                 continue
             try:
+                # QuietDatabase's "data distribution idle" signal: a checker
+                # must not race an in-flight relocation's splice/publish
+                self._dd_moving = True
                 await self._dd_once()
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise
                 TraceEvent("DDRoundFailed", self.process.address) \
                     .detail("Error", e.name).log()
+            finally:
+                self._dd_moving = False
 
     async def _dd_once(self):
         info = self.dbinfo

@@ -229,6 +229,50 @@ class AttritionWorkload(Workload):
                 loop.spawn(reboot_later(), name="attritionReboot")
 
 
+async def quiet_database(c, db, max_wait: float = 120.0,
+                         max_tlog_bytes: int = 100_000,
+                         max_storage_lag: int = 2_000_000):
+    """QuietDatabase (fdbserver/QuietDatabase.actor.cpp): checks may only
+    run on a SETTLED cluster — every TLog queue drained below a threshold,
+    every storage server's durability lag bounded, and data distribution
+    idle (no in-flight relocation) — otherwise invariant checks race the
+    pipeline's own catch-up work."""
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.server.interfaces import Token
+    loop = c.loop
+    deadline = loop.now() + max_wait
+    client = db.process
+    while loop.now() < deadline:
+        cc = c.current_cc()
+        if cc is None:
+            await loop.delay(0.5)
+            continue
+        info = cc.dbinfo
+        ok = not getattr(cc, "_dd_moving", False)
+        worst_log = worst_lag = 0
+        last_ep = info.log_epochs[-1] if info.log_epochs else None
+        addrs = (list(last_ep.addrs) if last_ep else []) +                 [a for a, _t in info.storages]
+        for addr in addrs:
+            try:
+                st = await loop.timeout(c.net.request(
+                    client, Endpoint(addr, Token.QUEUE_STATS), None), 1.0)
+                worst_log = max(worst_log, st.queue_bytes)
+                worst_lag = max(worst_lag, st.lag_versions)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                ok = False
+                break
+        if ok and worst_log <= max_tlog_bytes \
+                and worst_lag <= max_storage_lag:
+            TraceEvent("QuietDatabaseDone", "spec") \
+                .detail("TLogBytes", worst_log) \
+                .detail("StorageLag", worst_lag).log()
+            return
+        await loop.delay(1.0)
+    TraceEvent("QuietDatabaseTimeout", "spec", severity=30).log()
+
+
 @dataclass
 class SpecResult:
     seed: int
@@ -287,6 +331,7 @@ def run_spec(seed: int, workloads: list[Workload] | None = None,
                 except FDBError:
                     pass
             await c.loop.delay(0.5)
+        await quiet_database(c, db)
         for w in workloads:
             await w.check(db)
 

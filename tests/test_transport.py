@@ -246,3 +246,36 @@ def test_multiprocess_restart_recovers_wire_wal(tmp_path):
             p.terminate()
         for p in (txn_p, sto_p):
             p.wait(timeout=10)
+
+
+def test_networktest_tool_measures_the_wire():
+    """networktest (fdbserver -r networktest): parallel request streams over
+    the real transport report throughput + latency percentiles."""
+    import socket
+
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.tools.networktest import run_load, start_receiver
+
+    def free_addr():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        a = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        return a
+
+    loop = RealEventLoop()
+    srv = NetTransport(loop, free_addr())
+    cli = NetTransport(loop, free_addr())
+    srv.start()
+    cli.start()
+    start_receiver(srv.process)
+
+    async def go():
+        return await run_load(cli, cli.process, srv.address, streams=8,
+                              payload_bytes=128, seconds=1.0)
+    report = loop.run_future(loop.spawn(go()), max_time=30.0)
+    assert report["requests"] > 200, report
+    assert report["p50_ms"] is not None and report["p50_ms"] < 50
+    assert report["mbit_per_sec"] > 0
+    cli.close()
+    srv.close()
