@@ -171,3 +171,41 @@ def test_region_failover_loses_no_acked_commit():
                    for a in ep.addrs[:np_]), ep
 
     c.run(c.loop.spawn(t()), max_time=120_000.0)
+
+
+def test_region_failover_with_device_backend():
+    """Composition of the round's features: the DEVICE conflict engine
+    serving commits while a whole-region failover happens — recoveries
+    re-instantiate the engine (fresh conflict state) in the surviving
+    region with zero acked loss."""
+    KNOBS.set("CONFLICT_BACKEND", "device")
+    KNOBS.set("CONFLICT_BATCH_TXNS", 16)
+    KNOBS.set("CONFLICT_BATCH_READS_PER_TXN", 2)
+    KNOBS.set("CONFLICT_BATCH_WRITES_PER_TXN", 2)
+    KNOBS.set("CONFLICT_STATE_CAPACITY", 2048)
+    try:
+        c = RecoverableCluster.two_region(seed=47)
+        db = client(c)
+
+        async def t():
+            await db.refresh()
+            await db.transact(setup_ring)
+            rotate = make_rotate(c)
+            for i in range(4):
+                async def w(tr, i=i):
+                    await rotate(tr)
+                    tr.set(b"acked", b"%04d" % (i + 1))
+                await db.transact(w, max_retries=500)
+            c.kill_dc("dc0")
+
+            async def read_acked(tr):
+                return await tr.get(b"acked")
+            acked = await db.transact(read_acked, max_retries=2000)
+            assert acked == b"0004", acked
+            await check_ring(db)
+            cc = c.current_cc()
+            assert c.net.processes[cc.dbinfo.master].dc_id == "dc1"
+
+        c.run(c.loop.spawn(t()), max_time=120_000.0)
+    finally:
+        KNOBS.reset()
