@@ -199,15 +199,23 @@ def _make_db(loop, proxies, boundaries, storages):
     return client, db
 
 
-async def _run_phase(loop, db, kind, clients, seconds):
-    """Drive `clients` concurrent actors for `seconds`; returns
-    (ops, grv_latencies, commit_latencies)."""
+async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
+    """Drive `clients` concurrent actors; the first `ramp` seconds are
+    UNTIMED (client spawn, first GRVs, batchers warming) and the counters
+    reset when the measured window opens — steady-state numbers, less
+    run-to-run variance."""
     from foundationdb_tpu.core.future import all_of
 
-    stop_at = time.perf_counter() + seconds
+    stop_at = time.perf_counter() + seconds + ramp
     ops = [0]
     grv_lat: list[float] = []
     commit_lat: list[float] = []
+
+    async def ramp_reset():
+        await loop.delay(ramp)
+        ops[0] = 0
+        grv_lat.clear()
+        commit_lat.clear()
 
     async def one_client(cid):
         import random
@@ -242,7 +250,7 @@ async def _run_phase(loop, db, kind, clients, seconds):
                 pass  # retries are the app's concern; keep pumping
 
     tasks = [loop.spawn(one_client(c), name=f"bench{c}")
-             for c in range(clients)]
+             for c in range(clients)] + [loop.spawn(ramp_reset(), name="ramp")]
     for t in tasks:
         await t
     return ops[0], grv_lat, commit_lat
@@ -370,5 +378,13 @@ if __name__ == "__main__":
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}
+    if "oracle" in backends:
+        # the reference's own methodology point (100 clients,
+        # benchmarking.rst) — latency percentiles are only meaningful below
+        # saturation, so the GRV/commit latency targets are judged here
+        out["oracle"]["latency_100_clients"] = {
+            k: v for k, v in run(clients=100, seconds=4.0,
+                                 n_client_procs=1).items()
+            if k in ("write", "read", "mixed")}
     print(json.dumps(out if len(backends) > 1 else out[backends[0]],
                      indent=2))
