@@ -274,8 +274,10 @@ def test_networktest_tool_measures_the_wire():
         return await run_load(cli, cli.process, srv.address, streams=8,
                               payload_bytes=128, seconds=1.0)
     report = loop.run_future(loop.spawn(go()), max_time=30.0)
-    assert report["requests"] > 200, report
-    assert report["p50_ms"] is not None and report["p50_ms"] < 50
+    assert report["requests"] > 50, report
+    # generous bound: this asserts the tool MEASURES, not that this CI box
+    # is fast — a loaded single-core host can be slow legitimately
+    assert report["p50_ms"] is not None and report["p50_ms"] < 500
     assert report["mbit_per_sec"] > 0
     cli.close()
     srv.close()
