@@ -1,0 +1,73 @@
+"""CLI: `python -m foundationdb_tpu.analysis [paths...]`.
+
+Exit codes: 0 = clean (every finding baselined), 1 = new violations,
+2 = usage error. `--update-baseline` regenerates the allowlist, carrying
+forward documented reasons and stamping FIXME on new entries so an
+undocumented grandfather can never slip through tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from foundationdb_tpu.analysis import flowlint
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.analysis",
+        description="flowlint: actor-discipline & determinism analyzer")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: the foundationdb_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=flowlint.default_baseline_path(),
+                        help="baseline allowlist path (default: the "
+                             "checked-in flowlint_baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = flowlint.active_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.code}  {r.summary}")
+        return 0
+
+    paths = args.paths or [flowlint.default_target()]
+    findings = flowlint.analyze_paths(paths, rules)
+
+    if args.update_baseline:
+        flowlint.write_baseline(args.baseline, findings,
+                                flowlint.load_baseline(args.baseline))
+        print(f"baseline written: {args.baseline} "
+              f"({len(findings)} finding(s))", file=sys.stderr)
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        baseline = flowlint.load_baseline(args.baseline)
+        new, stale = flowlint.apply_baseline(findings, baseline)
+
+    out = (flowlint.format_json(new) if args.format == "json"
+           else flowlint.format_text(new))
+    if out:
+        print(out)
+    for entry in stale:
+        print(f"warning: stale baseline entry "
+              f"{flowlint._entry_key(entry)} matches nothing "
+              f"(run --update-baseline)", file=sys.stderr)
+    if new:
+        print(f"flowlint: {len(new)} new violation(s) "
+              f"({len(findings) - len(new)} baselined)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""flowlint: AST-based actor-discipline & determinism analyzer.
+
+The deterministic simulator (core/sim.py, after fdbrpc/sim2.actor.cpp) only
+delivers its replay guarantee if no actor code smuggles in wall-clock time,
+OS randomness, or settle-skipping control flow. This engine walks Python
+sources, runs a registry of rules (rules.py, FLOW001..FLOW006) over each
+module's AST, and diffs the findings against a checked-in baseline of
+documented grandfathered violations — so every new violation fails tier-1
+(tests/test_flowlint.py) the moment it is written.
+
+Engine pieces:
+  - Finding: one violation, with a line-number-independent identity key
+    (rule, path, enclosing symbol, detail) so baselines survive edits.
+  - ModuleContext: parsed module + parent links + qualname/suppression
+    helpers shared by all rules.
+  - Rule: base class; rules self-register via @register.
+  - analyze_source / analyze_paths: run the registry over snippets or trees.
+  - baseline load/apply/write: the allowlist workflow
+    (`python -m foundationdb_tpu.analysis --update-baseline`).
+
+Inline suppression: a line containing `# flowlint: ignore[FLOW00X]` (or
+`ignore[all]`) is exempt — for the rare spot where the rule's static
+approximation is provably wrong and a baseline entry would be noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+PACKAGE_NAME = "foundationdb_tpu"
+
+# Subpackages whose coroutines are sim-visible: they run under the
+# deterministic loop and must draw time/randomness from it.
+SIM_VISIBLE = ("core", "server", "net")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # "FLOW001"
+    path: str       # package-rooted posix path, e.g. foundationdb_tpu/server/resolver.py
+    line: int
+    symbol: str     # enclosing qualname ("Resolver._drain_group") or "<module>"
+    detail: str     # stable token for baseline identity (offending name/attr)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-independent identity used for baseline matching."""
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.detail}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "detail": self.detail,
+                "message": self.message}
+
+
+class ModuleContext:
+    """One parsed module plus the derived maps every rule needs."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    # -- path classification --
+
+    @property
+    def subpackage(self) -> str:
+        """First directory under the package root ("server", "core", ...)."""
+        parts = self.relpath.split("/")
+        if parts and parts[0] == PACKAGE_NAME:
+            parts = parts[1:]
+        return parts[0] if len(parts) > 1 else ""
+
+    @property
+    def sim_visible(self) -> bool:
+        return self.subpackage in SIM_VISIBLE
+
+    # -- tree helpers --
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing def/async def, or None at module/class level."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        names = [anc.name for anc in self.ancestors(node)
+                 if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.insert(0, node.name)
+        return ".".join(reversed(names)) or "<module>"
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        text = self.lines[line - 1]
+        if "flowlint:" not in text:
+            return False
+        tag = text.split("flowlint:", 1)[1]
+        return f"ignore[{rule}]" in tag or "ignore[all]" in tag
+
+    # -- import resolution (aliases -> dotted module names) --
+
+    @property
+    def import_aliases(self) -> dict[str, str]:
+        """Maps local name -> dotted origin: `import time` -> {"time":
+        "time"}; `import jax.numpy as jnp` -> {"jnp": "jax.numpy"};
+        `from time import sleep` -> {"sleep": "time.sleep"}."""
+        cached = getattr(self, "_aliases", None)
+        if cached is not None:
+            return cached
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+                    if a.asname is None and "." in a.name:
+                        # `import jax.numpy` binds "jax" but makes the
+                        # submodule reachable as jax.numpy — record the root
+                        aliases[a.name.split(".")[0]] = a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self._aliases = aliases
+        return aliases
+
+    def resolve_dotted(self, node: ast.AST) -> str | None:
+        """Dotted origin of a Name/Attribute chain, through import aliases:
+        with `import time as t`, `t.sleep` resolves to "time.sleep"."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        origin = self.import_aliases.get(cur.id)
+        if origin is None:
+            return None
+        parts.append(origin)
+        return ".".join(reversed(parts))
+
+
+class Rule:
+    """One check. Subclasses set `code`/`summary` and implement check()."""
+
+    code = "FLOW000"
+    summary = ""
+
+    def check(self, mod: ModuleContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleContext, node: ast.AST, detail: str,
+                message: str) -> Finding:
+        return Finding(rule=self.code, path=mod.relpath,
+                       line=getattr(node, "lineno", 0),
+                       symbol=mod.qualname(node), detail=detail,
+                       message=message)
+
+
+_REGISTRY: list[type[Rule]] = []
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    _REGISTRY.append(cls)
+    return cls
+
+
+def active_rules() -> list[Rule]:
+    # rules.py populates the registry on import
+    from foundationdb_tpu.analysis import rules  # noqa: F401
+    return [cls() for cls in sorted(_REGISTRY, key=lambda c: c.code)]
+
+
+# ---------------------------------------------------------------- running
+
+def analyze_source(source: str, relpath: str,
+                   rules: list[Rule] | None = None) -> list[Finding]:
+    """Run the registry over one module's source (tests feed snippets here;
+    `relpath` decides path-scoped rules like FLOW001)."""
+    tree = ast.parse(source)
+    mod = ModuleContext(relpath, source, tree)
+    out: list[Finding] = []
+    for rule in (rules if rules is not None else active_rules()):
+        for f in rule.check(mod):
+            if not mod.suppressed(f.line, f.rule):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def canonical_relpath(abspath: str) -> str:
+    """Package-rooted path for baseline stability: the same file keys
+    identically no matter what directory the analyzer was launched from."""
+    parts = os.path.abspath(abspath).replace(os.sep, "/").split("/")
+    if PACKAGE_NAME in parts:
+        return "/".join(parts[parts.index(PACKAGE_NAME):])
+    return os.path.relpath(abspath).replace(os.sep, "/")
+
+
+def iter_py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def analyze_paths(paths: list[str],
+                  rules: list[Rule] | None = None) -> list[Finding]:
+    rules = rules if rules is not None else active_rules()
+    out: list[Finding] = []
+    for path in paths:
+        for file in iter_py_files(path):
+            with open(file, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                out.extend(analyze_source(source, canonical_relpath(file),
+                                          rules))
+            except SyntaxError as e:
+                out.append(Finding(
+                    rule="FLOW000", path=canonical_relpath(file),
+                    line=e.lineno or 0, symbol="<module>",
+                    detail="syntax-error",
+                    message=f"could not parse: {e.msg}"))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# ---------------------------------------------------------------- baseline
+
+@dataclass
+class Baseline:
+    """Allowlist of grandfathered findings. Every entry must carry a
+    non-empty `reason` documenting why it is tolerated — update-baseline
+    inserts a FIXME placeholder that the tier-1 test rejects."""
+
+    path: str | None = None
+    entries: list[dict] = field(default_factory=list)
+
+    @property
+    def keys(self) -> set[str]:
+        return {_entry_key(e) for e in self.entries}
+
+
+def _entry_key(entry: dict) -> str:
+    return (f"{entry['rule']}:{entry['path']}:{entry['symbol']}:"
+            f"{entry['detail']}")
+
+
+def load_baseline(path: str | None) -> Baseline:
+    if path is None or not os.path.exists(path):
+        return Baseline(path=path)
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Baseline(path=path, entries=list(data.get("entries", [])))
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: Baseline) -> tuple[list[Finding], list[dict]]:
+    """-> (new findings not in the baseline, stale entries matching nothing)."""
+    keys = baseline.keys
+    new = [f for f in findings if f.key not in keys]
+    live = {f.key for f in findings}
+    stale = [e for e in baseline.entries if _entry_key(e) not in live]
+    return new, stale
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   old: Baseline) -> Baseline:
+    """Regenerate the baseline from current findings, carrying forward the
+    documented reasons of entries that still match."""
+    reasons = {_entry_key(e): e.get("reason", "") for e in old.entries}
+    entries, seen = [], set()
+    for f in findings:
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({
+            "rule": f.rule, "path": f.path, "symbol": f.symbol,
+            "detail": f.detail,
+            "reason": reasons.get(f.key) or "FIXME: document why this is safe",
+        })
+    data = {"version": 1, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return Baseline(path=path, entries=entries)
+
+
+# ---------------------------------------------------------------- output
+
+def format_text(findings: list[Finding]) -> str:
+    return "\n".join(f"{f.path}:{f.line}: {f.rule} [{f.symbol}] {f.message}"
+                     for f in findings)
+
+
+def format_json(findings: list[Finding]) -> str:
+    return json.dumps({"findings": [f.as_dict() for f in findings]},
+                      indent=2, sort_keys=True)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "flowlint_baseline.json")
+
+
+def default_target() -> str:
+    """The package directory itself (analyze everything)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -33,8 +33,16 @@ class BlobStoreBackupContainer(BackupContainer):
     are objects under <bucket>/<name>, written with a CRC-32C integrity
     header that reads verify, with bounded retries around every request."""
 
-    def __init__(self, url: str, bucket: str = "backup", retries: int = 3):
+    #: retry pacing (the reference blob store's bounded exponential backoff,
+    #: BlobStore.actor.cpp knobs REQUEST_TRIES/BACKOFF): first retry after
+    #: BACKOFF_BASE seconds, doubling up to BACKOFF_MAX.
+    BACKOFF_BASE = 0.05
+    BACKOFF_MAX = 1.0
+
+    def __init__(self, url: str, bucket: str = "backup", retries: int = 3,
+                 sleep=None):
         from foundationdb_tpu.net.http import HTTPConnection, HTTPError, _crc32c
+        import time
         assert url.startswith("blobstore://"), url
         hostport = url[len("blobstore://"):].rstrip("/")
         host, _, port = hostport.partition(":")
@@ -43,10 +51,18 @@ class BlobStoreBackupContainer(BackupContainer):
         self._retries = retries
         self._HTTPError = HTTPError
         self._crc = _crc32c
+        self._sleep = sleep if sleep is not None else time.sleep
 
     def _request(self, method, path, headers=None, body=b""):
         last = None
-        for _ in range(self._retries):
+        for attempt in range(self._retries):
+            if attempt:
+                # back off before every retry: hammering a briefly
+                # unavailable store back-to-back (and compounding with
+                # HTTPConnection's own reconnect attempt) turns transient
+                # blips into instant failures
+                self._sleep(min(self.BACKOFF_MAX,
+                                self.BACKOFF_BASE * (2 ** (attempt - 1))))
             try:
                 return self._conn.request(method, path, headers, body)
             except (OSError, self._HTTPError) as e:

@@ -68,8 +68,12 @@ class _Network:
 
         def go():
             task = self.loop.spawn(coro, name=name)
+            with fut._mutex:
+                fut._task = task
+                cancelled = fut._cancelled
+            if cancelled:
+                task.cancel()  # cancel() raced in before the task existed
             task.add_callback(fut._resolve_from)
-            fut._task = task
         self._started.wait()
         self.loop.aio.call_soon_threadsafe(go)
         return fut
@@ -85,23 +89,44 @@ class FDBFuture:
 
     def __init__(self):
         self._event = threading.Event()
+        # _mutex orders resolution against callback registration and
+        # cancellation: set_callback either registers before the settle or
+        # fires immediately, cancel() and _resolve_from() race to settle
+        # exactly once, and callbacks fire exactly once — on whichever
+        # thread won. Callbacks themselves run OUTSIDE the mutex so a
+        # callback may re-enter (get_error, destroy) without deadlocking.
+        self._mutex = threading.Lock()
+        self._settled = False
         self._value = None
         self._error: FDBError | None = None
         self._callbacks: list = []
         self._task = None
         self._cancelled = False
 
+    def _settle(self, value, error) -> list:
+        """Settle once under the mutex; -> callbacks to fire (empty if a
+        concurrent settle already won)."""
+        with self._mutex:
+            if self._settled:
+                return []
+            self._settled = True
+            self._value = value
+            self._error = error
+            cbs, self._callbacks = self._callbacks, []
+        self._event.set()  # after state is visible, before callbacks run
+        return cbs
+
     # -- resolution (network thread) --
 
     def _resolve_from(self, framework_future):
         if framework_future.is_error():
             e = framework_future._result
-            self._error = (e if isinstance(e, FDBError)
-                           else FDBError("unknown_error", repr(e)))
+            error = (e if isinstance(e, FDBError)
+                     else FDBError("unknown_error", repr(e)))
+            cbs = self._settle(None, error)
         else:
-            self._value = framework_future._result
-        self._event.set()
-        for cb, arg in self._callbacks:
+            cbs = self._settle(framework_future._result, None)
+        for cb, arg in cbs:
             cb(self, arg)
 
     # -- the fdb_future_* surface --
@@ -115,24 +140,30 @@ class FDBFuture:
 
     def set_callback(self, callback, callback_parameter=None) -> int:
         """fdb_future_set_callback: fires on the network thread, or
-        immediately if already ready (the reference's contract)."""
-        if self._event.is_set():
-            callback(self, callback_parameter)
-        else:
-            self._callbacks.append((callback, callback_parameter))
+        immediately if already ready (the reference's contract). Holding
+        the mutex across the registered/settled decision closes the race
+        where a callback registered mid-resolution was never invoked."""
+        with self._mutex:
+            if not self._settled:
+                self._callbacks.append((callback, callback_parameter))
+                return 0
+        self._event.wait()  # settle publishes state before firing callbacks
+        callback(self, callback_parameter)
         return 0
 
     def cancel(self):
-        self._cancelled = True
-        if self._task is not None and _network is not None:
-            _network.loop.aio.call_soon_threadsafe(self._task.cancel)
-        if not self._event.is_set():
-            self._error = FDBError("operation_cancelled")
-            self._event.set()
+        with self._mutex:
+            self._cancelled = True
+            task = self._task
+        if task is not None and _network is not None:
+            _network.loop.aio.call_soon_threadsafe(task.cancel)
+        for cb, arg in self._settle(None, FDBError("operation_cancelled")):
+            cb(self, arg)
 
     def destroy(self):
-        self._callbacks = []
-        self._task = None
+        with self._mutex:
+            self._callbacks = []
+            self._task = None
 
     def get_error(self) -> int:
         self._event.wait()

@@ -57,7 +57,10 @@ class Transaction:
     def _deadline_guard(self, fut):
         """Wrap an awaited future with the transaction's timeout option
         (NativeAPI: timed-out transactions raise transaction_timed_out,
-        surfaced here as the retryable timed_out)."""
+        surfaced here as the retryable timed_out). Applied to EVERY
+        operation — GRV, reads, range reads, watches, commit — matching the
+        reference, where option 500 bounds the whole transaction, not just
+        its write path."""
         if self._opt_timeout_ms is None:
             return fut
         return self.db.loop.timeout(fut, self._opt_timeout_ms / 1000.0)
@@ -93,7 +96,7 @@ class Transaction:
         if cleared:
             return None
         version = await self.get_read_version()
-        base = await self.db._read_get(key, version)
+        base = await self._deadline_guard(self.db._read_get(key, version))
         if not snapshot:
             self._read_conflicts.append((key, key + b"\x00"))
         if has_point:
@@ -123,7 +126,7 @@ class Transaction:
             # no read version yet: fall back to the coroutine path (it
             # fetches one); callers batching reads fetch the GRV first
             return self.db.loop.spawn(self.get(key, snapshot), "get")
-        inner = self.db._read_get(key, self._read_version)
+        inner = self._deadline_guard(self.db._read_get(key, self._read_version))
         if not snapshot:
             self._read_conflicts.append((key, key + b"\x00"))
         if not has_point:
@@ -201,7 +204,7 @@ class Transaction:
                 begin=KeySelector.first_greater_or_equal(cur_lo),
                 end=KeySelector.first_greater_or_equal(cur_hi),
                 version=version, limit=fetch_limit, reverse=reverse)
-            reply = await self.db._get_range(req)
+            reply = await self._deadline_guard(self.db._get_range(req))
             rows.update(reply.data)
             if reply.more and reply.data:
                 if reverse:
@@ -254,8 +257,9 @@ class Transaction:
         """Future resolving when `key`'s value changes after commit time."""
         version = await self.get_read_version()
         value = await self.get(key, snapshot=True)
-        return self.db._watch(WatchValueRequest(key=key, value=value,
-                                                version=version))
+        return self._deadline_guard(
+            self.db._watch(WatchValueRequest(key=key, value=value,
+                                             version=version)))
 
     # -- writes --
 

@@ -22,12 +22,35 @@ import threading
 from urllib.parse import quote, unquote
 
 
+_CRC32C_TABLE: list[int] | None = None
+
+
+def _crc32c_table() -> list[int]:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    return _CRC32C_TABLE
+
+
 def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli). The pure-Python fallback computes the SAME
+    polynomial as the native module: a store written by a native-enabled
+    host must verify on a pure-Python host and vice versa — zlib.crc32
+    (plain CRC-32) here would fail every cross-host restore."""
     from foundationdb_tpu import native
     if native.available():
         return native.mod.crc32c(data)
-    import zlib
-    return zlib.crc32(data)  # fallback checksum (consistent per process)
+    table = _crc32c_table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = table[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
 
 
 # ---------------------------------------------------------------- client

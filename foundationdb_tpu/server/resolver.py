@@ -143,15 +143,15 @@ class Resolver:
         handles = [h for _req, _reply, h in entries]
         err = None
         try:
-            await loop.run_blocking(lambda hs=handles: drain_handles(hs))
-        except FDBError as e:
-            if e.name == "operation_cancelled":
-                raise  # killed/displaced mid-drain: die, don't reply
-            err = e
-        except BaseException as e:  # noqa: BLE001 — fail the whole group
-            err = FDBError("internal_error", str(e))
-        await self._drained_seq.when_at_least(seq - 1)
-        try:
+            try:
+                await loop.run_blocking(lambda hs=handles: drain_handles(hs))
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise  # killed/displaced mid-drain: die, don't reply
+                err = e
+            except BaseException as e:  # noqa: BLE001 — fail the whole group
+                err = FDBError("internal_error", str(e))
+            await self._drained_seq.when_at_least(seq - 1)
             for req, reply, handle in entries:
                 if err is None:
                     try:
@@ -168,7 +168,21 @@ class Resolver:
                     continue
                 self._finish_batch(req, reply, statuses)
         finally:
-            self._drained_seq.set(seq)
+            # The finally covers BOTH awaits: a cancel landing in
+            # run_blocking or in the ordering wait must still advance the
+            # sequencing gate, or every later drain group wedges forever on
+            # when_at_least(seq - 1) (round-5 ADVICE, resolver.py:148).
+            self._advance_drained(seq)
+
+    def _advance_drained(self, seq: int):
+        """Advance the drain-ordering gate to `seq` without ever moving it
+        backwards or jumping over a still-running predecessor group: if the
+        gate hasn't reached seq - 1 yet, chain the advance off the
+        predecessor's settle instead of setting out of order."""
+        def advance(_f=None):
+            if self._drained_seq.get() < seq:
+                self._drained_seq.set(seq)
+        self._drained_seq.when_at_least(seq - 1).add_callback(advance)
 
     def _finish_batch(self, req: ResolveTransactionBatchRequest, reply,
                       statuses: list[int]):
