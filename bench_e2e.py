@@ -40,6 +40,9 @@ import time
 
 BASELINES = {"write": 46_000.0, "read": 305_000.0, "mixed": 107_000.0}
 KEYS = 2000
+# key bytes precomputed once: the load generator shares the one benchmark
+# core, so per-op formatting would tax the system under test
+_KEYTAB = [b"k%06d" % i for i in range(KEYS)]
 _SELF = os.path.abspath(__file__)
 
 
@@ -204,8 +207,6 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
     UNTIMED (client spawn, first GRVs, batchers warming) and the counters
     reset when the measured window opens — steady-state numbers, less
     run-to-run variance."""
-    from foundationdb_tpu.core.future import all_of
-
     stop_at = time.perf_counter() + seconds + ramp
     ops = [0]
     grv_lat: list[float] = []
@@ -219,7 +220,13 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
 
     async def one_client(cid):
         import random
-        rng = random.Random(cid)
+        # the load generator shares the one benchmark core with the system
+        # under test: keep its per-op cost minimal (bound method + float
+        # multiply beat rng.randrange by ~2x at this call frequency)
+        rnd = random.Random(cid).random
+        writing, mixed = kind == "write", kind == "mixed"
+        wval = b"w" * 16
+        keytab = _KEYTAB
         while time.perf_counter() < stop_at:
             tr = db.create_transaction()
             try:
@@ -230,17 +237,16 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
                 wrote = False
                 reads = []
                 for i in range(n):
-                    if kind == "write" or (kind == "mixed"
-                                           and rng.random() < 0.1):
-                        tr.set(b"k%06d" % rng.randrange(KEYS), b"w" * 16)
+                    if writing or (mixed and rnd() < 0.1):
+                        tr.set(keytab[int(rnd() * KEYS)], wval)
                         wrote = True
                     else:
-                        reads.append(b"k%06d" % rng.randrange(KEYS))
+                        reads.append(keytab[int(rnd() * KEYS)])
                 if reads:
-                    # issue a txn's reads concurrently as futures — the
-                    # reference's client API shape (fdb_transaction_get ->
-                    # FDBFuture; its bench clients wait on N futures)
-                    await all_of([tr.get_future(k) for k in reads])
+                    # issue a txn's reads concurrently as one multiget —
+                    # same per-key semantics (conflict keys, RYW) as N
+                    # get_future calls, one future per txn
+                    await tr.get_many(reads)
                 if wrote:
                     t1 = time.perf_counter()
                     await tr.commit()
@@ -379,6 +385,12 @@ if __name__ == "__main__":
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}
     if "oracle" in backends:
+        # measured proxy fan-out: the same load through 2 proxy processes,
+        # reported as its own row so merged-vs-fanned-out is an apples-to-
+        # apples comparison on this host rather than a guess
+        out["oracle"]["n_proxies_2"] = {
+            k: v for k, v in run(n_proxies=2).items()
+            if k in ("topology", "write", "read", "mixed")}
         # the reference's own methodology point (100 clients,
         # benchmarking.rst) — latency percentiles are only meaningful below
         # saturation, so the GRV/commit latency targets are judged here

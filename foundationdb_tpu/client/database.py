@@ -13,7 +13,8 @@ round-trip per GRV_BATCH_INTERVAL, like readVersionBatcher.
 from __future__ import annotations
 
 from foundationdb_tpu.client.transaction import Transaction
-from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.core.eventloop import ActorTask
+from foundationdb_tpu.core.future import Future, all_of
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
     GetKeyValuesReply, GetKeyValuesRequest, GetReadVersionRequest,
@@ -55,6 +56,8 @@ class LocationCache:
 
     def locate(self, key: bytes) -> tuple[list[str], bytes | None]:
         """(replica addresses, end of the containing shard; None = +inf)."""
+        if len(self.boundaries) == 1:  # one shard owns everything
+            return self.teams[0], None
         i = keylib.partition_index(self.boundaries, key)
         end = self.boundaries[i + 1] if i + 1 < len(self.boundaries) else None
         return self.teams[i], end
@@ -75,6 +78,58 @@ _CLUSTER_ERRORS = frozenset({
     "broken_promise", "cluster_not_fully_recovered", "tlog_stopped",
     "coordinators_changed", "timed_out", "commit_unknown_result",
 })
+
+# errors that mean "this replica is down, not the shard": try the next one
+_FAILOVER_ERRORS = ("broken_promise", "request_maybe_delivered")
+
+
+class ReplicaStats:
+    """Per-replica smoothed request latency (the QueueModel backing
+    loadBalance, fdbrpc/QueueModel.h): one EWMA per address, fed by every
+    completed read. Unknown replicas report the team's best known latency so
+    a fresh replica gets probed instead of starved."""
+
+    __slots__ = ("ewma",)
+
+    def __init__(self):
+        self.ewma: dict[str, float] = {}
+
+    def record(self, addr: str, latency: float):
+        prev = self.ewma.get(addr)
+        alpha = KNOBS.LOAD_BALANCE_EWMA_ALPHA
+        self.ewma[addr] = latency if prev is None \
+            else prev + alpha * (latency - prev)
+
+    def expected(self, addr: str, default: float) -> float:
+        return self.ewma.get(addr, default)
+
+    def order(self, team: list[str], rng) -> list[str]:
+        """Team sorted fastest-first. Unknown replicas inherit the best
+        known EWMA, and every estimate gets a small multiplicative jitter —
+        near-equal replicas keep swapping places (so load spreads and the
+        model keeps sampling everyone), while a genuinely slow replica
+        stays last."""
+        if len(team) <= 1:
+            return list(team)
+        known = [v for a in team if (v := self.ewma.get(a)) is not None]
+        default = min(known) if known else 0.0
+        return sorted(team, key=lambda a: self.expected(a, default)
+                      * (0.8 + 0.4 * rng.random()))
+
+
+def _relay_list(subs: list[Future], f: Future):
+    """Resolve `f` with the list of `subs` values (first error wins) — the
+    reassembly step for a multiget decomposed across shards."""
+    inner = all_of(subs)
+
+    def relay(s):
+        if f.is_ready():
+            return
+        if s.is_error():
+            f._set_error(s._result)
+        else:
+            f._set(s._result)
+    inner.add_callback(relay)
 
 
 class Database:
@@ -103,6 +158,11 @@ class Database:
         # dominates a Python host's read path
         self._read_queue: list[tuple[bytes, int, Future]] = []
         self._read_armed = False
+        # knob cached off the hot path (re-read at every flush): the knob
+        # registry's __getattr__ is measurable at per-read frequency
+        self._read_batch_max = KNOBS.READ_BATCH_MAX
+        # per-replica latency model driving read load balance + hedging
+        self._replica_stats = ReplicaStats()
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)
@@ -219,31 +279,111 @@ class Database:
             await self.refresh()
 
     def _team_order(self, team: list[str]) -> list[str]:
-        """Load balance: random first replica, the rest as failover backups
-        (loadBalance's firstRequest/backupRequest pattern)."""
-        if len(team) <= 1:
-            return list(team)
-        start = self._rng.randint(0, len(team) - 1)
-        return team[start:] + team[:start]
+        """Load balance: replicas ordered by smoothed latency (EWMA), the
+        rest as failover/backup targets (loadBalance's firstRequest /
+        backupRequest pattern over QueueModel estimates)."""
+        return self._replica_stats.order(team, self._rng)
+
+    def _backup_delay(self, addr: str) -> float:
+        """How long `addr`'s request may stay in flight before a duplicate
+        goes to the next replica (LoadBalance.actor.h:159 backup request)."""
+        expected = self._replica_stats.expected(
+            addr, KNOBS.LOAD_BALANCE_MIN_BACKUP_DELAY)
+        return max(KNOBS.LOAD_BALANCE_MIN_BACKUP_DELAY,
+                   KNOBS.LOAD_BALANCE_BACKUP_MULT * expected)
+
+    def _as_future(self, awaitable) -> Future:
+        """Normalize fn(addr)'s result: net.request hands back a Future
+        already; async wrappers (range fetches) come back as coroutines."""
+        if isinstance(awaitable, Future):
+            return awaitable
+        return self.process.spawn(awaitable, "lbAttempt")
+
+    def _first_settled(self, futs: list[Future],
+                       timeout: float | None) -> Future:
+        """Future of whichever of `futs` settles first (value OR error —
+        unlike any_of, an error must not win past a slower success here);
+        resolves to None at `timeout` so the caller can hedge."""
+        sel = Future()
+
+        def on_done(f: Future):
+            if not sel.is_ready():
+                sel._set(f)
+
+        for f in futs:
+            f.add_callback(on_done)
+        if timeout is not None:
+            self.loop._schedule(
+                timeout, 0,
+                lambda: sel._set(None) if not sel.is_ready() else None)
+        return sel
 
     async def _on_team(self, team: list[str], fn):
-        """Run `await fn(addr)` against the team with replica failover: a
-        down replica (broken_promise / dropped packet) falls over to the
-        next member; wrong_shard_server escapes for the caller's cache
-        re-resolution; anything else propagates. THE single failover policy
-        for every read path (loadBalance, fdbrpc/LoadBalance.actor.h:159)."""
+        """Run `await fn(addr)` against the team: fastest-known replica
+        first, a duplicate backup request to the next replica once the
+        first exceeds its expected-latency deadline (first settled answer
+        wins), and hard failover on down-replica errors. wrong_shard_server
+        escapes for the caller's cache re-resolution; anything else
+        propagates. THE single read-path policy (loadBalance,
+        fdbrpc/LoadBalance.actor.h:159)."""
+        order = self._team_order(team)
+        stats = self._replica_stats
+        if len(order) == 1:  # merged topologies: skip the hedging machinery
+            start = self.loop.now()
+            result = await fn(order[0])
+            stats.record(order[0], self.loop.now() - start)
+            return result
+        inflight: list[tuple[str, float, Future]] = []
         last: FDBError | None = None
-        for addr in self._team_order(team):
-            try:
-                return await fn(addr)
-            except FDBError as e:
-                if e.name in ("operation_cancelled", "wrong_shard_server"):
-                    raise
+        idx = 0
+        launch = True
+        try:
+            while True:
+                if launch and idx < len(order):
+                    addr = order[idx]
+                    idx += 1
+                    inflight.append((addr, self.loop.now(),
+                                     self._as_future(fn(addr))))
+                launch = False
+                if not inflight:
+                    raise last or FDBError("all_alternatives_failed")
+                # hedge off the OLDEST in-flight request's deadline
+                addr0, start0, _f0 = inflight[0]
+                remaining = None
+                if idx < len(order):
+                    remaining = max(
+                        0.0,
+                        start0 + self._backup_delay(addr0) - self.loop.now())
+                winner = await self._first_settled(
+                    [f for _a, _s, f in inflight], remaining)
+                if winner is None:
+                    # deadline passed: the laggard's outstanding time IS a
+                    # latency observation (it may never settle in-window),
+                    # so the model stops preferring it; then hedge
+                    stats.record(addr0, self.loop.now() - start0)
+                    launch = True
+                    continue
+                pos = next(i for i, (_a, _s, f) in enumerate(inflight)
+                           if f is winner)
+                addr, start, _f = inflight.pop(pos)
+                if not winner.is_error():
+                    stats.record(addr, self.loop.now() - start)
+                    return winner.get()
+                e = winner._result
+                if not isinstance(e, FDBError) or e.name in (
+                        "operation_cancelled", "wrong_shard_server"):
+                    raise e
+                # a failed attempt reads as slow so ordering learns from it
+                stats.record(addr, self._backup_delay(addr))
                 last = e
-                if e.name in ("broken_promise", "request_maybe_delivered"):
-                    continue  # replica down: try the next team member
-                raise
-        raise last or FDBError("all_alternatives_failed")
+                if e.name in _FAILOVER_ERRORS:
+                    launch = not inflight  # replica down: move on
+                    continue
+                raise e
+        finally:
+            for _a, _s, f in inflight:
+                if isinstance(f, ActorTask):
+                    f.cancel()
 
     async def _storage_request(self, key: bytes, token: int, req,
                                max_attempts: int = 5):
@@ -268,9 +408,31 @@ class Database:
         """Batched point read resolving to the RAW value (bytes | None) —
         one future per read, shared all the way to the caller."""
         f = Future()
-        self._read_queue.append((key, version, f))
-        if len(self._read_queue) >= KNOBS.READ_BATCH_MAX:
-            queue, self._read_queue = self._read_queue, []
+        queue = self._read_queue
+        queue.append((key, version, f))
+        if len(queue) >= self._read_batch_max:
+            self._read_queue = []
+            self.process.spawn(self._send_read_batches(queue), "readBatch")
+        elif not self._read_armed:
+            self._read_armed = True
+            self.process.spawn(self._read_flush(), "readBatcher")
+        return f
+
+    def _read_get_many(self, keys, version: int) -> Future:
+        """Batched multiget: ONE future resolving to the list of raw values
+        for `keys` (order preserved). Rides the same read batcher as
+        _read_get — queue entries whose key slot is a tuple carry several
+        reads — so a transaction's point reads cost one future + one queue
+        entry instead of N of each. (The batch-size knob counts entries,
+        not keys; multigets make batches proportionally larger.)"""
+        f = Future()
+        if not keys:
+            f._set([])
+            return f
+        queue = self._read_queue
+        queue.append((tuple(keys), version, f))
+        if len(queue) >= self._read_batch_max:
+            self._read_queue = []
             self.process.spawn(self._send_read_batches(queue), "readBatch")
         elif not self._read_armed:
             self._read_armed = True
@@ -278,6 +440,7 @@ class Database:
         return f
 
     async def _read_flush(self):
+        self._read_batch_max = KNOBS.READ_BATCH_MAX
         await self.loop.delay(KNOBS.READ_BATCH_INTERVAL)
         self._read_armed = False
         queue, self._read_queue = self._read_queue, []
@@ -293,33 +456,86 @@ class Database:
                 if not f.is_ready():
                     f._set_error(FDBError(e.name, e.detail))
             return
+        teams = self.locations.teams
+        if len(teams) == 1:  # unsharded cluster: the whole batch is one group
+            await self._send_read_group(list(teams[0]), entries)
+            return
+        locate = self.locations.locate
         groups: dict[tuple, list] = {}
-        for k, v, f in entries:
-            team, _end = self.locations.locate(k)
-            groups.setdefault(tuple(team), []).append((k, v, f))
+        for ent in entries:
+            k = ent[0]
+            if type(k) is bytes:
+                team, _end = locate(k)
+                groups.setdefault(tuple(team), []).append(ent)
+                continue
+            # multiget entry: keep it whole when one team covers every key,
+            # else decompose into per-key futures and reassemble
+            t0 = tuple(locate(k[0])[0])
+            if all(tuple(locate(kk)[0]) == t0 for kk in k[1:]):
+                groups.setdefault(t0, []).append(ent)
+                continue
+            keys, v, f = ent
+            subs = [Future() for _ in keys]
+            for kk, sf in zip(keys, subs):
+                team, _end = locate(kk)
+                groups.setdefault(tuple(team), []).append((kk, v, sf))
+            _relay_list(subs, f)
         for team, ents in groups.items():
             self.process.spawn(self._send_read_group(list(team), ents),
                                "readBatchGroup")
 
-    def _read_fallback(self, k: bytes, v: int, f: Future):
-        """Single-key path for a read that fell out of a batch: re-resolves
-        the location cache and fails over on its own."""
-        inner = self.loop.spawn(self._storage_request(
-            k, Token.STORAGE_GET_VALUE,
-            GetValueRequest(key=k, version=v)), "getValue")
+    def _read_fallback(self, k, v: int, f: Future):
+        """Per-entry path for a read that fell out of a batch: re-resolves
+        the location cache and fails over on its own. `k` is a single key
+        (bytes) or a multiget's key tuple."""
+        if type(k) is bytes:
+            inner = self.loop.spawn(self._storage_request(
+                k, Token.STORAGE_GET_VALUE,
+                GetValueRequest(key=k, version=v)), "getValue")
 
-        def relay(s):
+            def relay(s):
+                if f.is_ready():
+                    return
+                if s.is_error():
+                    f._set_error(s._result)
+                else:
+                    f._set(s._result.value)
+            inner.add_callback(relay)
+            return
+
+        async def gather():
+            out = []
+            for kk in k:
+                rep = await self._storage_request(
+                    kk, Token.STORAGE_GET_VALUE,
+                    GetValueRequest(key=kk, version=v))
+                out.append(rep.value)
+            return out
+
+        inner = self.loop.spawn(gather(), "getValues")
+
+        def relay_many(s):
             if f.is_ready():
                 return
             if s.is_error():
                 f._set_error(s._result)
             else:
-                f._set(s._result.value)
-        inner.add_callback(relay)
+                f._set(s._result)
+        inner.add_callback(relay_many)
 
     async def _send_read_group(self, team: list[str], ents):
         from foundationdb_tpu.server.interfaces import GetValuesRequest
-        req = GetValuesRequest(reads=[(k, v) for k, v, _f in ents])
+        reads = []
+        append = reads.append
+        flat = True
+        for k, v, _f in ents:
+            if type(k) is bytes:
+                append((k, v))
+            else:
+                flat = False
+                for kk in k:
+                    append((kk, v))
+        req = GetValuesRequest(reads=reads)
         try:
             rep = await self._on_team(
                 team, lambda addr: self.process.net.request(
@@ -335,17 +551,53 @@ class Database:
                 if not f.is_ready():
                     self._read_fallback(k, v, f)
             return
-        for (k, v, f), (code, payload) in zip(ents, rep.results):
+        if flat:
+            for (k, v, f), (code, payload) in zip(ents, rep.results):
+                if f.is_ready():
+                    continue
+                if code == 0:
+                    f._set(payload)
+                elif payload == "wrong_shard_server" and self.coordinators:
+                    # only this key's shard moved: re-resolve individually
+                    self.locations.invalidate()
+                    self._read_fallback(k, v, f)
+                else:
+                    f._set_error(FDBError(payload))
+            return
+        results = rep.results
+        i = 0
+        for k, v, f in ents:
+            if type(k) is bytes:
+                code, payload = results[i]
+                i += 1
+                if f.is_ready():
+                    continue
+                if code == 0:
+                    f._set(payload)
+                elif payload == "wrong_shard_server" and self.coordinators:
+                    self.locations.invalidate()
+                    self._read_fallback(k, v, f)
+                else:
+                    f._set_error(FDBError(payload))
+                continue
+            n = i + len(k)
+            chunk = results[i:n]
+            i = n
             if f.is_ready():
                 continue
-            if code == 0:
-                f._set(payload)
-            elif payload == "wrong_shard_server" and self.coordinators:
-                # only this key's shard moved: re-resolve it individually
+            bad = None
+            for code, payload in chunk:
+                if code != 0:
+                    bad = payload
+                    break
+            if bad is None:
+                f._set([p for _c, p in chunk])
+            elif bad == "wrong_shard_server" and self.coordinators:
+                # some key's shard moved: redo the whole multiget key-wise
                 self.locations.invalidate()
                 self._read_fallback(k, v, f)
             else:
-                f._set_error(FDBError(payload))
+                f._set_error(FDBError(bad))
 
 
     def _get_range(self, req: GetKeyValuesRequest) -> Future:

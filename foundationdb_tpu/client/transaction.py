@@ -13,12 +13,14 @@ All methods are actors on the framework event loop (await our Futures).
 from __future__ import annotations
 
 from foundationdb_tpu.client.writemap import WriteMap
+from foundationdb_tpu.core.future import Future, all_of
 from foundationdb_tpu.server.interfaces import (
     CommitTransactionRequest, GetKeyValuesRequest, GetReadVersionRequest,
     KeySelector, Token, WatchValueRequest)
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
-from foundationdb_tpu.utils.types import ATOMIC_OPS, MutationType
+from foundationdb_tpu.utils.types import (
+    ATOMIC_OPS, MutationType, mutations_weight)
 
 
 class Transaction:
@@ -68,12 +70,18 @@ class Transaction:
     def reset(self):
         self._writes = WriteMap()
         self._read_conflicts: list[tuple[bytes, bytes]] = []
+        # point-read conflicts stay as bare keys until commit: the read path
+        # is the client's hottest loop and the (key, key+\x00) range tuples
+        # are only needed by writing transactions
+        self._read_conflict_keys: list[bytes] = []
         self._extra_write_conflicts: list[tuple[bytes, bytes]] = []
         self._read_version: int | None = None
         self._rv_future = None
         self._committed_version: int | None = None
         self._backoff = KNOBS.DEFAULT_BACKOFF
         self._committing = False
+        self._key_limit = KNOBS.KEY_SIZE_LIMIT
+        self._value_limit = KNOBS.VALUE_SIZE_LIMIT
 
     # -- read version --
 
@@ -98,7 +106,7 @@ class Transaction:
         version = await self.get_read_version()
         base = await self._deadline_guard(self.db._read_get(key, version))
         if not snapshot:
-            self._read_conflicts.append((key, key + b"\x00"))
+            self._read_conflict_keys.append(key)
         if has_point:
             return point.resolve(base)  # pending atomic ops over storage value
         return base
@@ -111,26 +119,36 @@ class Transaction:
         read batcher and the returned Future resolves to the value. This is
         what lets a client issue a transaction's reads concurrently at
         reference-like per-op cost; `get` remains the awaitable convenience
-        wrapper."""
-        from foundationdb_tpu.core.future import Future
-        self._check_key(key)
-        has_point, point, cleared = self._writes.lookup(key)
-        out = Future()
-        if has_point and point.known:
-            out._set(point.value)
-            return out
-        if cleared:
-            out._set(None)
-            return out
+        wrapper. Every branch here is hand-flattened: this function is the
+        single hottest client frame under the e2e read bench."""
+        if len(key) > self._key_limit:
+            raise FDBError("key_too_large")
+        w = self._writes
+        if w.mutations:
+            has_point, point, cleared = w.lookup(key)
+            if has_point and point.known:
+                out = Future()
+                out._set(point.value)
+                return out
+            if cleared:
+                out = Future()
+                out._set(None)
+                return out
+        else:  # no overlay (the common read-mostly case): skip the lookup
+            has_point = False
+            point = None
         if self._read_version is None:
             # no read version yet: fall back to the coroutine path (it
             # fetches one); callers batching reads fetch the GRV first
             return self.db.loop.spawn(self.get(key, snapshot), "get")
-        inner = self._deadline_guard(self.db._read_get(key, self._read_version))
+        inner = self.db._read_get(key, self._read_version)
+        if self._opt_timeout_ms is not None:
+            inner = self.db.loop.timeout(inner, self._opt_timeout_ms / 1000.0)
         if not snapshot:
-            self._read_conflicts.append((key, key + b"\x00"))
+            self._read_conflict_keys.append(key)
         if not has_point:
             return inner  # the batcher's future IS the result future
+        out = Future()
 
         def relay(f):
             if out.is_ready():
@@ -141,6 +159,28 @@ class Transaction:
                 out._set(point.resolve(f._result))
         inner.add_callback(relay)
         return out
+
+    def get_many(self, keys, snapshot: bool = False):
+        """Future of the list of values for `keys` (order preserved) — a
+        transaction-level multiget. Equivalent to awaiting all_of over
+        per-key get_future calls, but the common case (no uncommitted-write
+        overlay, read version known) rides the database's read batcher as
+        ONE queue entry resolving ONE future, so a read transaction's
+        client-side cost no longer scales with per-key future machinery."""
+        w = self._writes
+        if w.mutations or self._read_version is None:
+            # overlay merge or GRV fetch needed: compose the per-key path
+            return all_of([self.get_future(k, snapshot) for k in keys])
+        limit = self._key_limit
+        for k in keys:
+            if len(k) > limit:
+                raise FDBError("key_too_large")
+        inner = self.db._read_get_many(keys, self._read_version)
+        if self._opt_timeout_ms is not None:
+            inner = self.db.loop.timeout(inner, self._opt_timeout_ms / 1000.0)
+        if not snapshot:
+            self._read_conflict_keys.extend(keys)
+        return inner
 
     async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (NativeAPI getKey). RYW-merged via a
@@ -264,8 +304,11 @@ class Transaction:
     # -- writes --
 
     def set(self, key: bytes, value: bytes):
-        self._check_key(key)
-        self._check_value(value)
+        # limit checks inlined (the hottest write-path frame)
+        if len(key) > self._key_limit:
+            raise FDBError("key_too_large")
+        if len(value) > self._value_limit:
+            raise FDBError("value_too_large")
         self._writes.set(key, value)
 
     def clear(self, key: bytes):
@@ -305,11 +348,16 @@ class Transaction:
                 # read-only: nothing to do (reference: commit of RO txn is local)
                 self._committed_version = self._read_version or 0
                 return
-            version = await self.get_read_version() if self._read_conflicts \
+            version = await self.get_read_version() \
+                if (self._read_conflicts or self._read_conflict_keys) \
                 else (self._read_version or 0)
+            read_conflicts = self._read_conflicts
+            if self._read_conflict_keys:
+                read_conflicts = read_conflicts + [
+                    (k, k + b"\x00") for k in self._read_conflict_keys]
             req = CommitTransactionRequest(
                 read_snapshot=version,
-                read_conflict_ranges=_coalesce(self._read_conflicts),
+                read_conflict_ranges=_coalesce(read_conflicts),
                 write_conflict_ranges=self._writes.write_conflict_ranges()
                 + getattr(self, "_extra_write_conflicts", []),
                 mutations=list(self._writes.mutations))
@@ -354,15 +402,15 @@ class Transaction:
     # -- limits (fdbclient/Knobs.cpp size limits) --
 
     def _check_key(self, key: bytes):
-        if len(key) > KNOBS.KEY_SIZE_LIMIT:
+        if len(key) > self._key_limit:  # limit cached at reset(): hot path
             raise FDBError("key_too_large")
 
     def _check_value(self, value: bytes):
-        if len(value) > KNOBS.VALUE_SIZE_LIMIT:
+        if len(value) > self._value_limit:
             raise FDBError("value_too_large")
 
     def _check_size(self, req: CommitTransactionRequest):
-        size = sum(m.weight() for m in req.mutations)
+        size = mutations_weight(req.mutations)
         size += sum(len(b) + len(e) for b, e in req.read_conflict_ranges)
         limit = KNOBS.TRANSACTION_SIZE_LIMIT
         if self._opt_size_limit is not None:

@@ -54,7 +54,7 @@ class ActorTask(Future):
     """
 
     __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled",
-                 "_observed")
+                 "_observed", "_started")
 
     def __init__(self, loop: "EventLoop", coro: Coroutine, name: str):
         super().__init__()
@@ -64,6 +64,15 @@ class ActorTask(Future):
         self._waiting_on: Future | None = None
         self._cancelled = False
         self._observed = False
+        self._started = False
+
+    def __del__(self):
+        # A task whose loop was abandoned before its first step holds a
+        # coroutine that never ran; close it so GC doesn't emit
+        # "coroutine ... was never awaited" (the silent-task-loss class —
+        # the suite runs with that warning promoted to an error).
+        if not self._started and not self.is_ready():
+            self._coro.close()
 
     def add_callback(self, cb):
         self._observed = True
@@ -97,12 +106,14 @@ class ActorTask(Future):
     def _step_cancel(self):
         if self.is_ready():
             return
+        self._started = True
         # If the actor swallows the cancellation (cleanup in an except/finally
         # that awaits), _drive registers on whatever it awaits next.
         self._cancelled = False
         self._drive(lambda: self._coro.throw(FDBError("operation_cancelled")))
 
     def _start(self):
+        self._started = True
         self._step()
 
     def _step(self):

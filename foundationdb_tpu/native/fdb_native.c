@@ -583,6 +583,19 @@ static PyObject *dec_value(RBuf *r, int depth) {
         PyObject *vobj = PyLong_FromLongLong(v);
         if (!vobj)
             return NULL;
+        /* member cache from the registry: calling an enum class goes
+           through the metaclass (__call__ -> __new__ -> value lookup),
+           measurable at per-mutation decode frequency */
+        if (PyTuple_GET_SIZE(entry) >= 3) {
+            PyObject *memo = PyTuple_GET_ITEM(entry, 2);
+            if (PyDict_Check(memo)) {
+                PyObject *member = PyDict_GetItem(memo, vobj);
+                if (member) {
+                    Py_DECREF(vobj);
+                    return Py_NewRef(member);
+                }
+            }
+        }
         PyObject *out = PyObject_CallOneArg(cls, vobj);
         Py_DECREF(vobj);
         return out; /* ValueError (bad member) -> wrapper fallback keeps
@@ -612,6 +625,40 @@ static PyObject *dec_value(RBuf *r, int depth) {
             /* schema skew (old/new peer): Python decoder handles defaults */
             PyErr_SetString(PyExc_OverflowError, "schema skew");
             return NULL;
+        }
+        /* fast construction for vanilla dataclasses (registry-flagged:
+           generated __init__, no __post_init__, no __slots__): allocate and
+           stuff the instance dict directly, the same bypass pickle uses.
+           Field order in `names` IS the generated __init__'s assignment
+           order, so the result is bit-identical to calling the class. */
+        if (PyTuple_GET_SIZE(entry) >= 3 &&
+            PyTuple_GET_ITEM(entry, 2) == Py_True &&
+            ((PyTypeObject *)cls)->tp_dictoffset > 0) {
+            PyTypeObject *tp = (PyTypeObject *)cls;
+            PyObject *obj = tp->tp_alloc(tp, 0);
+            if (!obj)
+                return NULL;
+            PyObject **dictptr = _PyObject_GetDictPtr(obj);
+            PyObject *d = PyDict_New();
+            if (!dictptr || !d) {
+                Py_XDECREF(d);
+                Py_DECREF(obj);
+                if (!dictptr)
+                    PyErr_SetString(PyExc_SystemError, "no instance dict");
+                return NULL;
+            }
+            *dictptr = d;
+            for (uint64_t i = 0; i < n; i++) {
+                PyObject *v = dec_value(r, depth + 1);
+                if (!v ||
+                    PyDict_SetItem(d, PyTuple_GET_ITEM(names, i), v) < 0) {
+                    Py_XDECREF(v);
+                    Py_DECREF(obj);
+                    return NULL;
+                }
+                Py_DECREF(v);
+            }
+            return obj;
         }
         PyObject *args = PyTuple_New(n);
         if (!args)
@@ -1148,6 +1195,867 @@ static PyTypeObject OMapType = {
     .tp_doc = "count+sum-augmented ordered bytes map (flow/IndexedSet.h)",
 };
 
+/* ------------------------------------------------------------------ */
+/* VStore: the storage server's MVCC read path                         */
+/*                                                                     */
+/* The VersionedMap.h analogue serving reads at any version inside the */
+/* MVCC window. Keys live in a cnt-augmented skiplist (same shape as   */
+/* IndexedSet above); each node carries the key's version chain as     */
+/* parallel arrays (int64 versions ascending, owned PyObject values,   */
+/* Py_None = tombstone). Point gets bisect the chain; range reads walk */
+/* level 0 with limit/byte-limit semantics; key selectors resolve      */
+/* in-C; and the *_encode methods emit a complete utils/wire.py reply  */
+/* frame (GetValuesReply / GetKeyValuesReply) in one pass, so a remote */
+/* read reply never round-trips through per-KV Python encoding.        */
+/*                                                                     */
+/* Version policy (oldest/latest tracking, order enforcement) stays in */
+/* the Python wrapper (server/versioned_map.py NativeVersionedMap),    */
+/* which is chosen by make_versioned_map() with the pure-Python        */
+/* VersionedMap as the parity-fuzzed fallback.                         */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    int64_t *versions;  /* ascending */
+    PyObject **values;  /* owned; Py_None = tombstone */
+    Py_ssize_t n, cap;
+} VChain;
+
+typedef struct VSNode {
+    PyObject *key; /* owned bytes; NULL for head */
+    VChain ch;
+    int level;
+    struct VSLink {
+        struct VSNode *next;
+        int64_t cnt; /* level-0 nodes in (this, next] */
+    } ln[1];
+} VSNode;
+
+typedef struct {
+    PyObject_HEAD
+    VSNode *head;
+    int cur_level;
+    Py_ssize_t n;
+    uint64_t rng;
+    int64_t bytes; /* byte_size(): sum len(key) + per-entry len(value)+16 */
+} VStore;
+
+/* shared constants built at module init */
+static PyObject *g_too_old_pair = NULL; /* (1, "transaction_too_old") */
+static PyObject *g_zero = NULL;         /* int 0 */
+static PyObject *g_hi32 = NULL;         /* b"\xff" * 32: selector scan end */
+static PyObject *g_sel_end = NULL;      /* b"\xff\xff": past-the-end sentinel */
+static PyObject *g_sel_begin = NULL;    /* b"": before-the-beginning sentinel */
+
+#define TOO_OLD_NAME "transaction_too_old"
+
+static inline int64_t vs_val_bytes(PyObject *v) {
+    return (v == Py_None ? 0 : (int64_t)PyBytes_GET_SIZE(v)) + 16;
+}
+
+/* rightmost index with versions[i] <= v, or -1 */
+static inline Py_ssize_t chain_bisect(const VChain *c, int64_t v) {
+    Py_ssize_t lo = 0, hi = c->n;
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) >> 1;
+        if (c->versions[mid] <= v)
+            lo = mid + 1;
+        else
+            hi = mid;
+    }
+    return lo - 1;
+}
+
+static int chain_push(VChain *c, int64_t version, PyObject *value) {
+    if (c->n == c->cap) {
+        Py_ssize_t cap = c->cap ? c->cap * 2 : 4;
+        int64_t *nv = PyMem_Realloc(c->versions, cap * sizeof(int64_t));
+        if (!nv) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        c->versions = nv;
+        PyObject **nvals = PyMem_Realloc(c->values, cap * sizeof(PyObject *));
+        if (!nvals) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        c->values = nvals;
+        c->cap = cap;
+    }
+    c->versions[c->n] = version;
+    Py_INCREF(value);
+    c->values[c->n] = value;
+    c->n++;
+    return 0;
+}
+
+static VSNode *vs_node_new(PyObject *key, int level) {
+    VSNode *x = malloc(sizeof(VSNode) + (level - 1) * sizeof(struct VSLink));
+    if (!x)
+        return NULL;
+    Py_XINCREF(key);
+    x->key = key;
+    x->level = level;
+    memset(&x->ch, 0, sizeof(VChain));
+    memset(x->ln, 0, level * sizeof(struct VSLink));
+    return x;
+}
+
+static void vs_node_free(VSNode *x) {
+    for (Py_ssize_t i = 0; i < x->ch.n; i++)
+        Py_DECREF(x->ch.values[i]);
+    PyMem_Free(x->ch.versions);
+    PyMem_Free(x->ch.values);
+    Py_XDECREF(x->key);
+    free(x);
+}
+
+static int vs_rand_level(VStore *self) {
+    uint64_t r = self->rng;
+    r ^= r << 13;
+    r ^= r >> 7;
+    r ^= r << 17;
+    self->rng = r;
+    int lv = 1;
+    while ((r & 3) == 3 && lv < OM_MAX_LEVEL) {
+        lv++;
+        r >>= 2;
+    }
+    return lv;
+}
+
+/* last node with key < target at every level, tracking the count prefix */
+static void vs_descend(VStore *self, PyObject *target, VSNode **update,
+                       int64_t *pcnt) {
+    VSNode *x = self->head;
+    int64_t c = 0;
+    for (int l = self->cur_level - 1; l >= 0; l--) {
+        while (x->ln[l].next && om_keycmp(x->ln[l].next->key, target) < 0) {
+            c += x->ln[l].cnt;
+            x = x->ln[l].next;
+        }
+        update[l] = x;
+        pcnt[l] = c;
+    }
+    for (int l = self->cur_level; l < OM_MAX_LEVEL; l++) {
+        update[l] = self->head;
+        pcnt[l] = 0;
+    }
+}
+
+static VSNode *vs_search(VStore *self, PyObject *key) {
+    VSNode *x = self->head;
+    for (int l = self->cur_level - 1; l >= 0; l--)
+        while (x->ln[l].next && om_keycmp(x->ln[l].next->key, key) < 0)
+            x = x->ln[l].next;
+    VSNode *nx = x->ln[0].next;
+    if (nx && om_keycmp(nx->key, key) == 0)
+        return nx;
+    return NULL;
+}
+
+/* number of keys strictly < key */
+static int64_t vs_rank(VStore *self, PyObject *key) {
+    VSNode *x = self->head;
+    int64_t c = 0;
+    for (int l = self->cur_level - 1; l >= 0; l--) {
+        while (x->ln[l].next && om_keycmp(x->ln[l].next->key, key) < 0) {
+            c += x->ln[l].cnt;
+            x = x->ln[l].next;
+        }
+    }
+    return c;
+}
+
+static VSNode *vs_nth(VStore *self, int64_t i) {
+    if (i < 0 || i >= (int64_t)self->n)
+        return NULL;
+    VSNode *x = self->head;
+    int64_t want = i + 1, acc = 0;
+    for (int l = self->cur_level - 1; l >= 0; l--) {
+        while (x->ln[l].next && acc + x->ln[l].cnt <= want) {
+            acc += x->ln[l].cnt;
+            x = x->ln[l].next;
+            if (acc == want)
+                return x;
+        }
+    }
+    return NULL; /* unreachable unless corrupt */
+}
+
+/* insert a fresh node for `key` (caller knows it is absent) */
+static VSNode *vs_insert(VStore *self, PyObject *key) {
+    VSNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL];
+    vs_descend(self, key, update, pcnt);
+    int lv = vs_rand_level(self);
+    if (lv > self->cur_level) {
+        for (int l = self->cur_level; l < lv; l++) {
+            update[l] = self->head;
+            pcnt[l] = 0;
+            self->head->ln[l].next = NULL;
+            self->head->ln[l].cnt = 0;
+        }
+        self->cur_level = lv;
+    }
+    VSNode *nb = vs_node_new(key, lv);
+    if (!nb) {
+        PyErr_NoMemory();
+        return NULL;
+    }
+    int64_t r0 = pcnt[0];
+    for (int l = 0; l < lv; l++) {
+        VSNode *next = update[l]->ln[l].next;
+        int64_t oc = update[l]->ln[l].cnt;
+        int64_t d1c = (r0 - pcnt[l]) + 1; /* (update[l], nb] */
+        nb->ln[l].next = next;
+        nb->ln[l].cnt = next ? oc - d1c + 1 : 0;
+        update[l]->ln[l].next = nb;
+        update[l]->ln[l].cnt = d1c;
+    }
+    for (int l = lv; l < self->cur_level; l++) {
+        if (update[l]->ln[l].next)
+            update[l]->ln[l].cnt += 1;
+    }
+    self->n++;
+    return nb;
+}
+
+static void vs_erase_node(VStore *self, VSNode **update, VSNode *node) {
+    for (int l = 0; l < node->level; l++) {
+        update[l]->ln[l].cnt += node->ln[l].cnt - 1;
+        update[l]->ln[l].next = node->ln[l].next;
+    }
+    for (int l = node->level; l < self->cur_level; l++) {
+        if (update[l]->ln[l].next)
+            update[l]->ln[l].cnt -= 1;
+    }
+    self->bytes -= PyBytes_GET_SIZE(node->key);
+    for (Py_ssize_t i = 0; i < node->ch.n; i++)
+        self->bytes -= vs_val_bytes(node->ch.values[i]);
+    vs_node_free(node);
+    self->n--;
+}
+
+static void vs_discard(VStore *self, PyObject *key) {
+    VSNode *update[OM_MAX_LEVEL];
+    int64_t pcnt[OM_MAX_LEVEL];
+    vs_descend(self, key, update, pcnt);
+    VSNode *at = update[0]->ln[0].next;
+    if (at && om_keycmp(at->key, key) == 0)
+        vs_erase_node(self, update, at);
+}
+
+/* -- write path (version order enforced by the Python wrapper) -- */
+
+static PyObject *vs_put(VStore *self, PyObject *args) {
+    PyObject *key, *value;
+    long long version;
+    if (!PyArg_ParseTuple(args, "SLO", &key, &version, &value))
+        return NULL;
+    if (value != Py_None && !PyBytes_Check(value)) {
+        PyErr_SetString(PyExc_TypeError, "value must be bytes or None");
+        return NULL;
+    }
+    VSNode *node = vs_search(self, key);
+    if (!node) {
+        if (value == Py_None)
+            Py_RETURN_NONE; /* clearing an absent key is a no-op */
+        node = vs_insert(self, key);
+        if (!node)
+            return NULL;
+        self->bytes += PyBytes_GET_SIZE(key);
+    }
+    VChain *c = &node->ch;
+    if (c->n && c->versions[c->n - 1] == version) {
+        self->bytes += vs_val_bytes(value) - vs_val_bytes(c->values[c->n - 1]);
+        Py_INCREF(value);
+        Py_SETREF(c->values[c->n - 1], value);
+    } else {
+        if (chain_push(c, version, value) < 0)
+            return NULL;
+        self->bytes += vs_val_bytes(value);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *vs_latest(VStore *self, PyObject *key) {
+    if (!PyBytes_Check(key)) {
+        PyErr_SetString(PyExc_TypeError, "key must be bytes");
+        return NULL;
+    }
+    VSNode *node = vs_search(self, key);
+    if (!node || node->ch.n == 0)
+        Py_RETURN_NONE;
+    return Py_NewRef(node->ch.values[node->ch.n - 1]);
+}
+
+static PyObject *vs_clear_range(VStore *self, PyObject *args) {
+    PyObject *begin, *end;
+    long long version;
+    if (!PyArg_ParseTuple(args, "SSL", &begin, &end, &version))
+        return NULL;
+    VSNode *x = self->head;
+    for (int l = self->cur_level - 1; l >= 0; l--)
+        while (x->ln[l].next && om_keycmp(x->ln[l].next->key, begin) < 0)
+            x = x->ln[l].next;
+    for (x = x->ln[0].next; x && om_keycmp(x->key, end) < 0;
+         x = x->ln[0].next) {
+        VChain *c = &x->ch;
+        if (c->n == 0 || c->values[c->n - 1] == Py_None)
+            continue; /* only live keys get a tombstone */
+        if (c->versions[c->n - 1] == version) {
+            self->bytes += 16 - vs_val_bytes(c->values[c->n - 1]);
+            Py_SETREF(c->values[c->n - 1], Py_NewRef(Py_None));
+        } else {
+            if (chain_push(c, version, Py_None) < 0)
+                return NULL;
+            self->bytes += 16;
+        }
+    }
+    Py_RETURN_NONE;
+}
+
+/* -- read path -- */
+
+static PyObject *vs_get(VStore *self, PyObject *args) {
+    PyObject *key;
+    long long version;
+    if (!PyArg_ParseTuple(args, "SL", &key, &version))
+        return NULL;
+    VSNode *node = vs_search(self, key);
+    if (!node)
+        Py_RETURN_NONE;
+    Py_ssize_t i = chain_bisect(&node->ch, version);
+    if (i < 0)
+        Py_RETURN_NONE;
+    return Py_NewRef(node->ch.values[i]);
+}
+
+/* split one (key, version) item from a reads list */
+static int vs_read_item(PyObject *item, PyObject **key, int64_t *version) {
+    PyObject *kb, *vb;
+    if (PyTuple_CheckExact(item) && PyTuple_GET_SIZE(item) == 2) {
+        kb = PyTuple_GET_ITEM(item, 0);
+        vb = PyTuple_GET_ITEM(item, 1);
+    } else if (PyList_CheckExact(item) && PyList_GET_SIZE(item) == 2) {
+        kb = PyList_GET_ITEM(item, 0);
+        vb = PyList_GET_ITEM(item, 1);
+    } else {
+        PyErr_SetString(PyExc_TypeError, "read must be a (key, version) pair");
+        return -1;
+    }
+    if (!PyBytes_Check(kb)) {
+        PyErr_SetString(PyExc_TypeError, "key must be bytes");
+        return -1;
+    }
+    long long v = PyLong_AsLongLong(vb);
+    if (v == -1 && PyErr_Occurred())
+        return -1;
+    *key = kb;
+    *version = v;
+    return 0;
+}
+
+static PyObject *vs_get_many(VStore *self, PyObject *args) {
+    PyObject *reads;
+    long long oldest;
+    if (!PyArg_ParseTuple(args, "OL", &reads, &oldest))
+        return NULL;
+    PyObject *seq = PySequence_Fast(reads, "reads must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (!out) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key;
+        int64_t version;
+        if (vs_read_item(PySequence_Fast_GET_ITEM(seq, i), &key, &version) < 0)
+            goto fail;
+        PyObject *pair;
+        if (version < oldest) {
+            pair = Py_NewRef(g_too_old_pair);
+        } else {
+            PyObject *val = Py_None;
+            VSNode *node = vs_search(self, key);
+            if (node) {
+                Py_ssize_t j = chain_bisect(&node->ch, version);
+                if (j >= 0)
+                    val = node->ch.values[j];
+            }
+            pair = PyTuple_Pack(2, g_zero, val);
+            if (!pair)
+                goto fail;
+        }
+        PyList_SET_ITEM(out, i, pair);
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return NULL;
+}
+
+/* -- wire-frame emitters (must byte-match utils/wire.py _py_dumps) -- */
+
+static inline int wb_zigzag(WBuf *w, int64_t v) {
+    return wb_varint(w, ((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
+}
+
+static inline int wb_bytes_val(WBuf *w, PyObject *v) {
+    if (v == Py_None)
+        return wb_byte(w, 'N');
+    Py_ssize_t n = PyBytes_GET_SIZE(v);
+    if (wb_byte(w, 'b') < 0 || wb_varint(w, (uint64_t)n) < 0)
+        return -1;
+    return wb_raw(w, PyBytes_AS_STRING(v), n);
+}
+
+/* get_many_encode(reads, oldest, tid) -> complete GetValuesReply frame */
+static PyObject *vs_get_many_encode(VStore *self, PyObject *args) {
+    PyObject *reads;
+    long long oldest;
+    unsigned long long tid;
+    if (!PyArg_ParseTuple(args, "OLK", &reads, &oldest, &tid))
+        return NULL;
+    PyObject *seq = PySequence_Fast(reads, "reads must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    WBuf w = {NULL, 0, 0};
+    if (wb_grow(&w, 64 + n * 24) < 0)
+        goto fail;
+    w.buf[w.len++] = W_MAGIC;
+    w.buf[w.len++] = W_VERSION;
+    /* GetValuesReply { results: [(0, value|None) | (1, errname)] } */
+    if (wb_byte(&w, 'R') < 0 || wb_varint(&w, tid) < 0 ||
+        wb_varint(&w, 1) < 0 || wb_byte(&w, 'l') < 0 ||
+        wb_varint(&w, (uint64_t)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key;
+        int64_t version;
+        if (vs_read_item(PySequence_Fast_GET_ITEM(seq, i), &key, &version) < 0)
+            goto fail;
+        if (wb_byte(&w, 't') < 0 || wb_varint(&w, 2) < 0)
+            goto fail;
+        if (version < oldest) {
+            size_t elen = strlen(TOO_OLD_NAME);
+            if (wb_byte(&w, 'i') < 0 || wb_varint(&w, 2) < 0 || /* int 1 */
+                wb_byte(&w, 's') < 0 || wb_varint(&w, elen) < 0 ||
+                wb_raw(&w, TOO_OLD_NAME, elen) < 0)
+                goto fail;
+        } else {
+            PyObject *val = Py_None;
+            VSNode *node = vs_search(self, key);
+            if (node) {
+                Py_ssize_t j = chain_bisect(&node->ch, version);
+                if (j >= 0)
+                    val = node->ch.values[j];
+            }
+            if (wb_byte(&w, 'i') < 0 || wb_varint(&w, 0) < 0 || /* int 0 */
+                wb_bytes_val(&w, val) < 0)
+                goto fail;
+        }
+    }
+    Py_DECREF(seq);
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+fail:
+    Py_DECREF(seq);
+    PyMem_Free(w.buf);
+    return NULL;
+}
+
+/* Range scan core: calls emit(ctx, key, value) for each live pair in
+ * [begin, end) at `version` honoring limit/limit_bytes; *more is set when a
+ * limit cut the scan short AND a live key remains (the Python
+ * range_read/_has_live_after semantics). Returns 0, or -1 on emit failure. */
+typedef int (*vs_emit_fn)(void *ctx, PyObject *key, PyObject *val);
+
+static int vs_scan(VStore *self, PyObject *begin, PyObject *end,
+                   int64_t version, Py_ssize_t limit, Py_ssize_t limit_bytes,
+                   int reverse, vs_emit_fn emit, void *ctx, int *more) {
+    *more = 0;
+    Py_ssize_t count = 0;
+    int64_t total = 0;
+    if (!reverse) {
+        VSNode *x = self->head;
+        for (int l = self->cur_level - 1; l >= 0; l--)
+            while (x->ln[l].next && om_keycmp(x->ln[l].next->key, begin) < 0)
+                x = x->ln[l].next;
+        x = x->ln[0].next;
+        for (; x && om_keycmp(x->key, end) < 0; x = x->ln[0].next) {
+            Py_ssize_t i = chain_bisect(&x->ch, version);
+            PyObject *v = i >= 0 ? x->ch.values[i] : Py_None;
+            if (v == Py_None)
+                continue;
+            if (emit(ctx, x->key, v) < 0)
+                return -1;
+            count++;
+            total += PyBytes_GET_SIZE(x->key) + PyBytes_GET_SIZE(v);
+            if ((limit && count >= limit) ||
+                (limit_bytes && total >= limit_bytes)) {
+                /* a limit fired: is anything live left in the range? */
+                for (x = x->ln[0].next;
+                     x && om_keycmp(x->key, end) < 0; x = x->ln[0].next) {
+                    Py_ssize_t j = chain_bisect(&x->ch, version);
+                    if (j >= 0 && x->ch.values[j] != Py_None) {
+                        *more = 1;
+                        break;
+                    }
+                }
+                return 0;
+            }
+        }
+        return 0;
+    }
+    /* reverse: rank-based backward walk (skiplists have no back links);
+     * O(k log n) per emitted key — reverse reads are rare and bounded */
+    int64_t idx = vs_rank(self, end) - 1;
+    int64_t lo = vs_rank(self, begin);
+    for (; idx >= lo; idx--) {
+        VSNode *x = vs_nth(self, idx);
+        if (!x)
+            break;
+        Py_ssize_t i = chain_bisect(&x->ch, version);
+        PyObject *v = i >= 0 ? x->ch.values[i] : Py_None;
+        if (v == Py_None)
+            continue;
+        if (emit(ctx, x->key, v) < 0)
+            return -1;
+        count++;
+        total += PyBytes_GET_SIZE(x->key) + PyBytes_GET_SIZE(v);
+        if ((limit && count >= limit) || (limit_bytes && total >= limit_bytes)) {
+            for (idx--; idx >= lo; idx--) {
+                VSNode *y = vs_nth(self, idx);
+                if (!y)
+                    break;
+                Py_ssize_t j = chain_bisect(&y->ch, version);
+                if (j >= 0 && y->ch.values[j] != Py_None) {
+                    *more = 1;
+                    break;
+                }
+            }
+            return 0;
+        }
+    }
+    return 0;
+}
+
+static int vs_emit_list(void *ctx, PyObject *key, PyObject *val) {
+    PyObject *pair = PyTuple_Pack(2, key, val);
+    if (!pair)
+        return -1;
+    int rc = PyList_Append((PyObject *)ctx, pair);
+    Py_DECREF(pair);
+    return rc;
+}
+
+/* wire-emit context: pairs are encoded into a side buffer while counting
+ * them, because the 'l' list header needs the count before the items */
+struct vs_wire_ctx {
+    WBuf *w;
+    Py_ssize_t count;
+};
+
+static int vs_emit_wire(void *ctxp, PyObject *key, PyObject *val) {
+    struct vs_wire_ctx *ctx = (struct vs_wire_ctx *)ctxp;
+    WBuf *w = ctx->w;
+    ctx->count++;
+    if (wb_byte(w, 't') < 0 || wb_varint(w, 2) < 0)
+        return -1;
+    if (wb_bytes_val(w, key) < 0 || wb_bytes_val(w, val) < 0)
+        return -1;
+    return 0;
+}
+
+static PyObject *vs_range_read(VStore *self, PyObject *args) {
+    PyObject *begin, *end;
+    long long version;
+    Py_ssize_t limit = 0, limit_bytes = 0;
+    int reverse = 0;
+    if (!PyArg_ParseTuple(args, "SSL|nnp", &begin, &end, &version, &limit,
+                          &limit_bytes, &reverse))
+        return NULL;
+    PyObject *out = PyList_New(0);
+    if (!out)
+        return NULL;
+    int more = 0;
+    if (vs_scan(self, begin, end, version, limit, limit_bytes, reverse,
+                vs_emit_list, out, &more) < 0) {
+        Py_DECREF(out);
+        return NULL;
+    }
+    PyObject *ret = Py_BuildValue("(NO)", out, more ? Py_True : Py_False);
+    if (!ret)
+        Py_DECREF(out);
+    return ret;
+}
+
+/* range_read_encode(begin, end, version, limit, limit_bytes, reverse, tid)
+ * -> complete GetKeyValuesReply{data, more, version} frame */
+static PyObject *vs_range_read_encode(VStore *self, PyObject *args) {
+    PyObject *begin, *end;
+    long long version;
+    Py_ssize_t limit = 0, limit_bytes = 0;
+    int reverse = 0;
+    unsigned long long tid = 0;
+    if (!PyArg_ParseTuple(args, "SSLnnpK", &begin, &end, &version, &limit,
+                          &limit_bytes, &reverse, &tid))
+        return NULL;
+    /* pairs go to a side buffer first: the 'l' header needs their count */
+    WBuf items = {NULL, 0, 0};
+    if (wb_grow(&items, 256) < 0)
+        return NULL;
+    struct vs_wire_ctx cctx = {&items, 0};
+    int more = 0;
+    if (vs_scan(self, begin, end, version, limit, limit_bytes, reverse,
+                vs_emit_wire, &cctx, &more) < 0) {
+        PyMem_Free(items.buf);
+        return NULL;
+    }
+    WBuf w = {NULL, 0, 0};
+    if (wb_grow(&w, 32 + items.len) < 0) {
+        PyMem_Free(items.buf);
+        return NULL;
+    }
+    w.buf[w.len++] = W_MAGIC;
+    w.buf[w.len++] = W_VERSION;
+    /* GetKeyValuesReply { data: [(k, v)], more: bool, version: int } */
+    if (wb_byte(&w, 'R') < 0 || wb_varint(&w, tid) < 0 ||
+        wb_varint(&w, 3) < 0 || wb_byte(&w, 'l') < 0 ||
+        wb_varint(&w, (uint64_t)cctx.count) < 0 ||
+        wb_raw(&w, (const char *)items.buf, items.len) < 0 ||
+        wb_byte(&w, more ? 'T' : 'F') < 0 || wb_byte(&w, 'i') < 0 ||
+        wb_zigzag(&w, version) < 0) {
+        PyMem_Free(items.buf);
+        PyMem_Free(w.buf);
+        return NULL;
+    }
+    PyMem_Free(items.buf);
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
+/* resolve_selector(key, or_equal, offset, version) -> resolved key bytes.
+ * Matches storage.py semantics exactly: forward selectors scan
+ * [key(+\x00), \xff*32) for the (offset)th live key, else b"\xff\xff";
+ * backward selectors scan (b"", key(+\x00)] downward, else b"". */
+struct vs_sel_ctx {
+    Py_ssize_t skip; /* live keys still to pass over */
+    PyObject *found;
+};
+
+static int vs_emit_sel(void *ctxp, PyObject *key, PyObject *val) {
+    struct vs_sel_ctx *ctx = (struct vs_sel_ctx *)ctxp;
+    (void)val;
+    if (ctx->skip == 0)
+        ctx->found = key; /* borrowed; limit stops the scan right after */
+    else
+        ctx->skip--;
+    return 0;
+}
+
+static PyObject *vs_resolve_selector(VStore *self, PyObject *args) {
+    PyObject *key;
+    int or_equal;
+    Py_ssize_t offset;
+    long long version;
+    if (!PyArg_ParseTuple(args, "SpnL", &key, &or_equal, &offset, &version))
+        return NULL;
+    /* or_equal shifts the boundary just past `key` */
+    PyObject *edge;
+    if (or_equal) {
+        Py_ssize_t klen = PyBytes_GET_SIZE(key);
+        edge = PyBytes_FromStringAndSize(NULL, klen + 1);
+        if (!edge)
+            return NULL;
+        memcpy(PyBytes_AS_STRING(edge), PyBytes_AS_STRING(key), klen);
+        PyBytes_AS_STRING(edge)[klen] = '\0';
+    } else {
+        edge = Py_NewRef(key);
+    }
+    struct vs_sel_ctx ctx;
+    int more = 0;
+    int rc;
+    if (offset >= 1) {
+        ctx.skip = offset - 1;
+        ctx.found = NULL;
+        rc = vs_scan(self, edge, g_hi32, version, ctx.skip + 1, 0, 0,
+                     vs_emit_sel, &ctx, &more);
+    } else {
+        ctx.skip = -offset;
+        ctx.found = NULL;
+        rc = vs_scan(self, g_sel_begin, edge, version, ctx.skip + 1, 0, 1,
+                     vs_emit_sel, &ctx, &more);
+    }
+    Py_DECREF(edge);
+    if (rc < 0)
+        return NULL;
+    if (ctx.found)
+        return Py_NewRef(ctx.found);
+    return Py_NewRef(offset >= 1 ? g_sel_end : g_sel_begin);
+}
+
+/* -- window maintenance -- */
+
+static PyObject *vs_forget_before(VStore *self, PyObject *arg) {
+    long long version = PyLong_AsLongLong(arg);
+    if (version == -1 && PyErr_Occurred())
+        return NULL;
+    PyObject *dead = PyList_New(0);
+    if (!dead)
+        return NULL;
+    for (VSNode *x = self->head->ln[0].next; x; x = x->ln[0].next) {
+        VChain *c = &x->ch;
+        Py_ssize_t i = chain_bisect(c, version);
+        if (i > 0) { /* keep the newest entry at-or-before `version` */
+            for (Py_ssize_t j = 0; j < i; j++) {
+                self->bytes -= vs_val_bytes(c->values[j]);
+                Py_DECREF(c->values[j]);
+            }
+            memmove(c->versions, c->versions + i,
+                    (c->n - i) * sizeof(int64_t));
+            memmove(c->values, c->values + i,
+                    (c->n - i) * sizeof(PyObject *));
+            c->n -= i;
+        }
+        if (c->n == 1 && c->values[0] == Py_None) {
+            if (PyList_Append(dead, x->key) < 0) {
+                Py_DECREF(dead);
+                return NULL;
+            }
+        }
+    }
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(dead); i++)
+        vs_discard(self, PyList_GET_ITEM(dead, i));
+    Py_DECREF(dead);
+    Py_RETURN_NONE;
+}
+
+static PyObject *vs_rollback(VStore *self, PyObject *arg) {
+    long long version = PyLong_AsLongLong(arg);
+    if (version == -1 && PyErr_Occurred())
+        return NULL;
+    PyObject *dead = PyList_New(0);
+    if (!dead)
+        return NULL;
+    for (VSNode *x = self->head->ln[0].next; x; x = x->ln[0].next) {
+        VChain *c = &x->ch;
+        Py_ssize_t keep = chain_bisect(c, version) + 1; /* entries <= version */
+        if (keep < c->n) {
+            for (Py_ssize_t j = keep; j < c->n; j++) {
+                self->bytes -= vs_val_bytes(c->values[j]);
+                Py_DECREF(c->values[j]);
+            }
+            c->n = keep;
+        }
+        if (c->n == 0) {
+            if (PyList_Append(dead, x->key) < 0) {
+                Py_DECREF(dead);
+                return NULL;
+            }
+        }
+    }
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(dead); i++)
+        vs_discard(self, PyList_GET_ITEM(dead, i));
+    Py_DECREF(dead);
+    Py_RETURN_NONE;
+}
+
+static PyObject *vs_byte_size(VStore *self, PyObject *noargs) {
+    (void)noargs;
+    return PyLong_FromLongLong(self->bytes);
+}
+
+static Py_ssize_t vs_len(VStore *self) { return self->n; }
+
+/* -- type boilerplate -- */
+
+static PyObject *vstore_new(PyTypeObject *type, PyObject *args,
+                            PyObject *kwds) {
+    (void)args;
+    (void)kwds;
+    VStore *self = (VStore *)type->tp_alloc(type, 0);
+    if (!self)
+        return NULL;
+    self->head = vs_node_new(NULL, OM_MAX_LEVEL);
+    if (!self->head) {
+        Py_DECREF(self);
+        return PyErr_NoMemory();
+    }
+    self->cur_level = 1;
+    self->n = 0;
+    self->bytes = 0;
+    self->rng = 0x9E3779B97F4A7C15ULL;
+    return (PyObject *)self;
+}
+
+static void vstore_dealloc(VStore *self) {
+    if (self->head) {
+        VSNode *x = self->head->ln[0].next;
+        while (x) {
+            VSNode *nx = x->ln[0].next;
+            vs_node_free(x);
+            x = nx;
+        }
+        vs_node_free(self->head);
+    }
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyMethodDef vs_methods[] = {
+    {"put", (PyCFunction)vs_put, METH_VARARGS,
+     "put(key, version, value_or_None)"},
+    {"latest", (PyCFunction)vs_latest, METH_O,
+     "latest(key) -> newest value (None if absent/cleared)"},
+    {"clear_range", (PyCFunction)vs_clear_range, METH_VARARGS,
+     "clear_range(begin, end, version): tombstone live keys in [begin, end)"},
+    {"get", (PyCFunction)vs_get, METH_VARARGS,
+     "get(key, version) -> value at version (None if absent/cleared)"},
+    {"get_many", (PyCFunction)vs_get_many, METH_VARARGS,
+     "get_many(reads, oldest) -> [(0, value) | (1, 'transaction_too_old')]"},
+    {"get_many_encode", (PyCFunction)vs_get_many_encode, METH_VARARGS,
+     "get_many_encode(reads, oldest, tid) -> GetValuesReply wire frame"},
+    {"range_read", (PyCFunction)vs_range_read, METH_VARARGS,
+     "range_read(begin, end, version, limit=0, limit_bytes=0, reverse=False)"
+     " -> (pairs, more)"},
+    {"range_read_encode", (PyCFunction)vs_range_read_encode, METH_VARARGS,
+     "range_read_encode(begin, end, version, limit, limit_bytes, reverse,"
+     " tid) -> GetKeyValuesReply wire frame"},
+    {"resolve_selector", (PyCFunction)vs_resolve_selector, METH_VARARGS,
+     "resolve_selector(key, or_equal, offset, version) -> resolved key"},
+    {"forget_before", (PyCFunction)vs_forget_before, METH_O,
+     "forget_before(version): trim chain prefixes outside the MVCC window"},
+    {"rollback", (PyCFunction)vs_rollback, METH_O,
+     "rollback(version): drop entries newer than version"},
+    {"byte_size", (PyCFunction)vs_byte_size, METH_NOARGS,
+     "byte_size() -> bookkeeping bytes (matches VersionedMap.byte_size)"},
+    {NULL, NULL, 0, NULL}};
+
+static PySequenceMethods vs_as_sequence = {
+    .sq_length = (lenfunc)vs_len,
+};
+
+static PyTypeObject VStoreType = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "fdb_native.VStore",
+    .tp_basicsize = sizeof(VStore),
+    .tp_dealloc = (destructor)vstore_dealloc,
+    .tp_as_sequence = &vs_as_sequence,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "MVCC versioned key store (storage server read path)",
+    .tp_methods = vs_methods,
+    .tp_new = vstore_new,
+};
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
@@ -1169,7 +2077,17 @@ static struct PyModuleDef moduledef = {
 
 PyMODINIT_FUNC PyInit_fdb_native(void) {
     crc32c_init();
-    if (PyType_Ready(&OMapType) < 0)
+    if (PyType_Ready(&OMapType) < 0 || PyType_Ready(&VStoreType) < 0)
+        return NULL;
+    g_zero = PyLong_FromLong(0);
+    g_too_old_pair = Py_BuildValue("(is)", 1, TOO_OLD_NAME);
+    g_sel_end = PyBytes_FromStringAndSize("\xff\xff", 2);
+    g_sel_begin = PyBytes_FromStringAndSize("", 0);
+    g_hi32 = PyBytes_FromStringAndSize(
+        "\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+        "\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff",
+        32);
+    if (!g_zero || !g_too_old_pair || !g_sel_end || !g_sel_begin || !g_hi32)
         return NULL;
     PyObject *m = PyModule_Create(&moduledef);
     if (!m)
@@ -1177,6 +2095,12 @@ PyMODINIT_FUNC PyInit_fdb_native(void) {
     Py_INCREF(&OMapType);
     if (PyModule_AddObject(m, "IndexedSet", (PyObject *)&OMapType) < 0) {
         Py_DECREF(&OMapType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&VStoreType);
+    if (PyModule_AddObject(m, "VStore", (PyObject *)&VStoreType) < 0) {
+        Py_DECREF(&VStoreType);
         Py_DECREF(m);
         return NULL;
     }

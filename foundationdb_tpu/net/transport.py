@@ -41,6 +41,16 @@ _CONNECT = b"fdbtpu" + bytes([PROTOCOL_VERSION])
 _REQUEST, _REPLY, _REPLY_ERROR, _ONE_WAY = 0, 1, 2, 3
 
 
+class _WireReplyPromise(Promise):
+    """Reply promise for a remote request: the result goes straight to
+    wire.dumps, so handlers may send a wire.PreEncoded frame. Class
+    attribute (Promise has __slots__); handlers probe it with
+    getattr(reply, "wants_bytes", False)."""
+
+    __slots__ = ()
+    wants_bytes = True
+
+
 class RealEventLoop(EventLoop):
     """The framework's event loop driven by real time on asyncio.
 
@@ -181,7 +191,8 @@ class NetTransport:
         # one Peer per remote address (FlowTransport.actor.cpp:222): the
         # in-flight connect is memoized so concurrent requests share it
         self._peers: dict[str, asyncio.Future] = {}
-        self._pending: dict[int, Promise] = {}  # reply_id -> promise
+        # reply_id -> (promise, peer address, timeout TimerHandle | None)
+        self._pending: dict[int, tuple] = {}
         self._next_reply_id = 1
         # every asyncio task this transport spawns (reply readers, sends):
         # close() cancels and drains them so teardown never leaks pending
@@ -294,7 +305,34 @@ class NetTransport:
             timeout = KNOBS.SIM_RPC_TIMEOUT_SECONDS
         reply_id = self._next_reply_id
         self._next_reply_id += 1
-        self._pending[reply_id] = (reply, dest.address)
+        handle = None
+        if timeout is not None:
+            def expire():
+                entry = self._pending.pop(reply_id, None)
+                if entry is not None and not entry[0].is_set():
+                    entry[0].send_error(FDBError("request_maybe_delivered"))
+            handle = self.loop.aio.call_later(timeout, expire)
+        self._pending[reply_id] = (reply, dest.address, handle)
+
+        peer = self._peers.get(dest.address)
+        if peer is not None and peer.done() and not peer.cancelled() \
+                and peer.exception() is None \
+                and not peer.result().is_closing():
+            # connected fast path: encode + write inline. No coroutine, no
+            # task, no drain await — the transport's write buffer provides
+            # the slack, and a dropped connection fails every pending
+            # request via _read_replies. This is the per-request hot path
+            # for a client under load (every GRV/read/commit lands here
+            # once the proxy connection exists).
+            try:
+                body = wire.dumps(payload)
+                peer.result().write(
+                    self._frame(dest.token, reply_id, _REQUEST, body))
+            except (OSError, wire.WireError) as e:
+                if isinstance(e, OSError):
+                    self._peers.pop(dest.address, None)
+                self._fail_pending(reply_id, "connect/encode failed")
+            return reply.future
 
         async def send():
             try:
@@ -305,19 +343,19 @@ class NetTransport:
             except (OSError, wire.WireError) as e:
                 if isinstance(e, OSError):
                     self._peers.pop(dest.address, None)
-                entry = self._pending.pop(reply_id, None)
-                if entry is not None and not entry[0].is_set():
-                    entry[0].send_error(FDBError("broken_promise",
-                                                 "connect/encode failed"))
+                self._fail_pending(reply_id, "connect/encode failed")
 
         self._spawn(send())
-        if timeout is not None:
-            def expire():
-                entry = self._pending.pop(reply_id, None)
-                if entry is not None and not entry[0].is_set():
-                    entry[0].send_error(FDBError("request_maybe_delivered"))
-            self.loop.aio.call_later(timeout, expire)
         return reply.future
+
+    def _fail_pending(self, reply_id: int, detail: str):
+        entry = self._pending.pop(reply_id, None)
+        if entry is None:
+            return
+        if entry[2] is not None:
+            entry[2].cancel()
+        if not entry[0].is_set():
+            entry[0].send_error(FDBError("broken_promise", detail))
 
     def _local_request(self, dest, payload, timeout) -> Future:
         from foundationdb_tpu.utils.knobs import KNOBS
@@ -448,7 +486,12 @@ class NetTransport:
                 writer.write(self._frame(0, reply_id, _REPLY_ERROR,
                                          wire.dumps("broken_promise")))
             return
-        inner = Promise()
+        # A remote request's reply is headed for wire.dumps either way, so
+        # the handler may answer with a wire.PreEncoded frame (the storage
+        # C read path) — signaled by wants_bytes on the reply promise.
+        # In-process requests (_local_request) hand the payload object to
+        # the caller directly and never take this path.
+        inner = _WireReplyPromise() if kind == _REQUEST else Promise()
         if kind == _REQUEST:
             def on_reply(f: Future):
                 try:
@@ -475,7 +518,11 @@ class NetTransport:
             while True:
                 _token, reply_id, kind, payload = await self._read_frame(reader)
                 entry = self._pending.pop(reply_id, None)
-                if entry is None or entry[0].is_set():
+                if entry is None:
+                    continue
+                if entry[2] is not None:
+                    entry[2].cancel()  # drop the RPC-timeout timer now
+                if entry[0].is_set():
                     continue
                 if kind == _REPLY:
                     entry[0].send(payload)
@@ -488,9 +535,11 @@ class NetTransport:
             # failure path of FlowTransport): waiting out the RPC timeout
             # stalls failover, and timeout=None waiters would leak forever
             self._peers.pop(address, None)
-            for rid in [r for r, (_p, a) in self._pending.items()
+            for rid in [r for r, (_p, a, _h) in self._pending.items()
                         if a == address]:
-                p, _a = self._pending.pop(rid)
+                p, _a, h = self._pending.pop(rid)
+                if h is not None:
+                    h.cancel()
                 if not p.is_set():
                     p.send_error(FDBError("broken_promise", "peer closed"))
             return

@@ -70,12 +70,22 @@ class OracleConflictSet:
     def add_range(self, begin: bytes, end: bytes, version: int):
         if end <= begin:
             return
-        self._ensure_boundary(begin)
-        self._ensure_boundary(end)
-        i0 = bisect_left(self.keys, begin)
-        i1 = bisect_left(self.keys, end)
+        # inlined double _ensure_boundary reusing the bisect positions:
+        # this is the resolver's per-write-range hot loop (one call per
+        # written key per committed transaction)
+        keys, vals = self.keys, self.vals
+        i0 = bisect_right(keys, begin) - 1
+        if keys[i0] != begin:
+            i0 += 1
+            keys.insert(i0, begin)
+            vals.insert(i0, vals[i0 - 1])
+        i1 = bisect_left(keys, end, i0)
+        if i1 == len(keys) or keys[i1] != end:
+            keys.insert(i1, end)
+            vals.insert(i1, vals[i1 - 1])
         for i in range(i0, i1):
-            self.vals[i] = max(self.vals[i], version)
+            if vals[i] < version:
+                vals[i] = version
 
     def remove_before(self, version: int, force: bool = False):
         """Advance the window floor; clamp + coalesce (removeBefore :665).

@@ -53,6 +53,8 @@ class ShardMap:
     tags: list[list[int]]  # tags serving shard i (len = len(boundaries))
 
     def tags_for_key(self, key: bytes) -> list[int]:
+        if len(self.boundaries) == 1:  # one shard: per-mutation hot path
+            return self.tags[0]
         i = self._shard_of(key)
         return self.tags[i]
 
@@ -551,10 +553,27 @@ class Proxy:
             # in resolver 0's request (ResolutionRequestBuilder :307-311)
             state_idx: list[list[int]] = [[] for _ in range(n_res)]
             state_muts: list[list[list]] = [[] for _ in range(n_res)]
+            sys_prefix = systemdata.SYSTEM_PREFIX
             for req in requests:
+                # cheap prefilter: a mutation can only touch the system
+                # keyspace if one of its params sorts at/after \xff (covers
+                # point keys AND clear-range ends), so ordinary traffic
+                # skips the full is_metadata_mutation call entirely
                 meta = [m for m in req.mutations
-                        if systemdata.is_metadata_mutation(m)]
+                        if (m.param1 >= sys_prefix or m.param2 >= sys_prefix)
+                        and systemdata.is_metadata_mutation(m)]
                 batch_meta.append(meta or None)
+                if n_res == 1 and not meta:
+                    # single resolver, no state txn: the split is the
+                    # identity and the slot list is one entry
+                    txn_resolver_slots.append([(0, len(res_txns[0]))])
+                    res_txns[0].append(TxnConflictInfo(
+                        read_snapshot=req.read_snapshot,
+                        read_ranges=[r for r in req.read_conflict_ranges
+                                     if r[0] < r[1]],
+                        write_ranges=[r for r in req.write_conflict_ranges
+                                      if r[0] < r[1]]))
+                    continue
                 split_r = self.resolvers.split_ranges(req.read_conflict_ranges)
                 split_w = self.resolvers.split_ranges(req.write_conflict_ranges)
                 touched = set(split_r) | set(split_w)
@@ -637,11 +656,18 @@ class Proxy:
                         self._apply_metadata(muts, version)
             state_applied = True
 
-            statuses = []
-            for slots in txn_resolver_slots:
-                # committed iff every touched resolver says committed (:492-504)
-                s = min(resolutions[r].committed[i] for r, i in slots)
-                statuses.append(s)
+            if n_res == 1:
+                # one slot per txn, appended in request order
+                committed0 = resolutions[0].committed
+                statuses = [committed0[slots[0][1]]
+                            for slots in txn_resolver_slots]
+            else:
+                statuses = []
+                for slots in txn_resolver_slots:
+                    # committed iff every touched resolver says committed
+                    # (:492-504)
+                    s = min(resolutions[r].committed[i] for r, i in slots)
+                    statuses.append(s)
 
             # own batch's committed metadata txns — ALL applied before any
             # mutation is routed (:540 precedes the routing loop :578), so
@@ -659,23 +685,38 @@ class Proxy:
             messages: dict[int, list[Mutation]] = {}
             batch_order = 0
             blog: list[Mutation] = []  # backup tee (:664-776)
+            # per-mutation loop: hoist attribute lookups and skip the
+            # backup scan when no backup ranges are registered
+            tags_for_range = self.shards.tags_for_range
+            tags_for_key = self.shards.tags_for_key
+            backup_ranges = self.backup_ranges
+            clear_t = MutationType.CLEAR_RANGE
+            vs_key = MutationType.SET_VERSIONSTAMPED_KEY
+            vs_val = MutationType.SET_VERSIONSTAMPED_VALUE
             for req, status in zip(requests, statuses):
                 if status != COMMITTED:
                     continue
                 stamp = make_versionstamp(commit_version, batch_order)
                 batch_order += 1
                 for m in req.mutations:
-                    m = self._substitute(m, stamp)
-                    if m.type == MutationType.CLEAR_RANGE:
-                        tags = self.shards.tags_for_range(m.param1, m.param2)
+                    mt = m.type
+                    if mt == vs_key or mt == vs_val:
+                        m = self._substitute(m, stamp)
+                        mt = m.type
+                    if mt == clear_t:
+                        tags = tags_for_range(m.param1, m.param2)
                     else:
-                        tags = self.shards.tags_for_key(m.param1)
+                        tags = tags_for_key(m.param1)
                     for t in tags:
-                        messages.setdefault(t, []).append(m)
-                    for rb_, re_ in self.backup_ranges:
-                        if systemdata.mutation_overlaps(m, rb_, re_):
-                            blog.append(m)
-                            break
+                        lst = messages.get(t)
+                        if lst is None:
+                            lst = messages[t] = []
+                        lst.append(m)
+                    if backup_ranges:
+                        for rb_, re_ in backup_ranges:
+                            if systemdata.mutation_overlaps(m, rb_, re_):
+                                blog.append(m)
+                                break
             if blog:
                 # tee into \xff/blog/<version><seq> INSIDE the same batch:
                 # the log row commits atomically with the data it records

@@ -23,11 +23,12 @@ from foundationdb_tpu.server.interfaces import (
     GetValueRequest, GetStorageMetricsRequest, KeySelector, LogEpoch,
     SetLogSystemRequest, SetShardsRequest, ShardMetrics, TLogPeekRequest,
     TLogPopRequest, Token, WatchValueRequest)
-from foundationdb_tpu.server.versioned_map import VersionedMap
+from foundationdb_tpu.server.versioned_map import make_versioned_map
 from foundationdb_tpu.storage.kvstore import MemoryKeyValueStore
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.types import Mutation, MutationType
+from foundationdb_tpu.utils import wire
 
 _DURABLE_VERSION_KEY = "durableVersion"
 _SSD_DIR: list[str] = []
@@ -98,7 +99,7 @@ class StorageServer:
         meta = self.store.get_metadata(_DURABLE_VERSION_KEY)
         self.durable_version = max(
             recovery_version, int(meta.decode()) if meta else 0)
-        self.data = VersionedMap(oldest_version=self.durable_version)
+        self.data = make_versioned_map(oldest_version=self.durable_version)
         for k, v in self.store.get_range(b"", b"\xff" * 32):
             self.data.apply(self.durable_version,
                             Mutation(MutationType.SET_VALUE, k, v))
@@ -499,58 +500,43 @@ class StorageServer:
         the whole batch, per-key MVCC lookups, per-key errors in the reply
         so one moved key doesn't fail its neighbors.
 
-        The lookup loop reads the versioned map's internals directly — this
-        handler is the host read path's hottest loop, and the wrapper stack
-        (get -> _check_version -> _value_at) costs more than the bisect."""
-        from bisect import bisect_right
-
+        When this server owns everything (serve_all) the whole batch is one
+        call into the versioned map — and for a remote caller
+        (reply.wants_bytes) the C store serializes the GetValuesReply frame
+        itself, so the reply never exists as per-KV Python objects."""
         from foundationdb_tpu.server.interfaces import GetValuesReply
         try:
             await self._wait_for_version(max(v for _k, v in req.reads))
         except FDBError as e:
             reply.send_error(e)  # retryable as a unit (future_version etc.)
             return
-        chains = self.data._chains
-        oldest = self.data.oldest_version
-        serve_all = self.shard_ranges is None
+        data = self.data
+        if self.shard_ranges is None:
+            if getattr(reply, "wants_bytes", False):
+                encode = getattr(data, "get_batch_encoded", None)
+                if encode is not None:
+                    reply.send(wire.PreEncoded(encode(req.reads)))
+                    return
+            reply.send(GetValuesReply(results=data.get_batch(req.reads)))
+            return
+        # sharded: per-key ownership checks need the shard map, so stay in
+        # Python (data movement traffic, not the merged-topology hot path)
+        oldest = data.oldest_version
         out = []
         for k, v in req.reads:
-            if not (serve_all or self._owns_key(k)):
+            if not self._owns_key(k):
                 out.append((1, "wrong_shard_server"))
             elif v < oldest:
                 out.append((1, "transaction_too_old"))
             else:
-                c = chains.get(k)
-                if c is None:
-                    out.append((0, None))
-                else:
-                    i = bisect_right(c[0], v) - 1
-                    out.append((0, c[1][i] if i >= 0 else None))
+                out.append((0, data.get(k, v)))
         reply.send(GetValuesReply(results=out))
 
-    # selector resolution (storageserver.actor.cpp findKey)
+    # selector resolution (storageserver.actor.cpp findKey) — lives on the
+    # versioned map so the C store resolves without per-key Python hops
     def _resolve_selector(self, sel: KeySelector, version: int) -> bytes:
         """Resolve to a live key (or b'' / \\xff end sentinels)."""
-        # forward: offset >= 1 means "offset-th live key at-or-after"
-        if sel.offset >= 1:
-            skip = sel.offset - 1
-            begin = sel.key + (b"\x00" if sel.or_equal else b"")
-            data, _ = self.data.range_read(begin, b"\xff" * 32, version,
-                                           limit=skip + 1)
-            if len(data) > skip:
-                return data[skip][0]
-            # past the end: \xff\xff (the systemKeys end) — a plain \xff
-            # sentinel would sort BELOW \xff-prefixed system keys and fold
-            # system-range reads empty
-            return b"\xff\xff"
-        # backward: offset <= 0 means "(1-offset)-th live key before"
-        skip = -sel.offset
-        end = sel.key + (b"\x00" if sel.or_equal else b"")
-        data, _ = self.data.range_read(b"", end, version, limit=skip + 1,
-                                       reverse=True)
-        if len(data) > skip:
-            return data[skip][0]
-        return b""
+        return self.data.resolve_selector(sel, version)
 
     def _on_get_key_values(self, req: GetKeyValuesRequest, reply):
         self.process.spawn(self._get_key_values(req, reply), "getKeyValues")
@@ -565,6 +551,15 @@ class StorageServer:
             if end < begin:
                 end = begin
             limit_bytes = req.limit_bytes or KNOBS.DESIRED_TOTAL_BYTES
+            if getattr(reply, "wants_bytes", False):
+                encode = getattr(self.data, "range_read_encoded", None)
+                if encode is not None:
+                    # remote caller: the C store scans AND serializes the
+                    # GetKeyValuesReply in one pass
+                    reply.send(wire.PreEncoded(encode(
+                        begin, end, req.version, req.limit, limit_bytes,
+                        req.reverse)))
+                    return
             data, more = self.data.range_read(
                 begin, end, req.version, limit=req.limit,
                 limit_bytes=limit_bytes, reverse=req.reverse)

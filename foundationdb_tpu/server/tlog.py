@@ -33,6 +33,7 @@ from foundationdb_tpu.server.interfaces import (
     TLogPeekReply, TLogPeekRequest, TLogPopRequest, Token)
 from foundationdb_tpu.storage.diskqueue import DiskQueue
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.types import mutations_weight
 
 
 class TLog:
@@ -40,7 +41,8 @@ class TLog:
                  file_name: str = "tlog.dq", register: bool = True):
         self.process = process
         self.version = NotifiedVersion(recovery_version)  # durable version
-        self.messages: dict[int, deque] = {}  # tag -> deque[(version, [Mutation])]
+        # tag -> deque[(version, [Mutation], weight)]
+        self.messages: dict[int, deque] = {}
         self.popped: dict[int, int] = {}  # tag -> pop floor
         self.known_committed_version = recovery_version
         self.locked = False  # epoch ended: no more commits (recovery lock)
@@ -98,8 +100,11 @@ class TLog:
             return
         for tag, muts in req.messages.items():
             if muts:
-                self.messages.setdefault(tag, deque()).append((req.version, muts))
-                w = sum(m.weight() for m in muts)
+                w = mutations_weight(muts)
+                # weight rides with the entry: peeks and pops of the same
+                # batch must not re-walk every mutation
+                self.messages.setdefault(tag, deque()).append(
+                    (req.version, muts, w))
                 self._mem_bytes += w
                 self._tag_sizes.setdefault(tag, deque()).append((req.version, w))
                 self._tag_bytes[tag] = self._tag_bytes.get(tag, 0) + w
@@ -131,8 +136,8 @@ class TLog:
                     oldest_v, oldest_tag = q[0][0], tag
             if oldest_tag is None:
                 return
-            v, muts = self.messages[oldest_tag].popleft()
-            self._mem_bytes -= sum(m.weight() for m in muts)
+            v, _muts, w = self.messages[oldest_tag].popleft()
+            self._mem_bytes -= w
             self._mem_floor[oldest_tag] = v + 1
 
     def _on_peek(self, req: TLogPeekRequest, reply):
@@ -170,7 +175,7 @@ class TLog:
                 muts = messages.get(tag)
                 if muts:
                     out.append((version, list(muts)))
-                    budget -= sum(m.weight() for m in muts)
+                    budget -= mutations_weight(muts)
                 last_v = max(last_v, version)
                 if budget <= 0:
                     break
@@ -181,11 +186,11 @@ class TLog:
                     known_committed_version=self.known_committed_version))
                 return
             last_v = floor - 1  # the whole spilled gap is covered
-        for v, muts in self.messages.get(tag, ()):
+        for v, muts, w in self.messages.get(tag, ()):
             if v <= last_v:
                 continue
             out.append((v, list(muts)))
-            budget -= sum(m.weight() for m in muts)
+            budget -= w
             last_v = v
             if budget <= 0:
                 break
@@ -199,8 +204,8 @@ class TLog:
         self.popped[req.tag] = max(self.popped.get(req.tag, 0), req.version)
         q = self.messages.get(req.tag)
         while q and q[0][0] < req.version:
-            _v, muts = q.popleft()
-            self._mem_bytes -= sum(m.weight() for m in muts)
+            _v, _muts, w = q.popleft()
+            self._mem_bytes -= w
         if req.version > self._mem_floor.get(req.tag, 0):
             self._mem_floor[req.tag] = req.version
         sizes = self._tag_sizes.get(req.tag)
@@ -239,8 +244,9 @@ class TLog:
             self._version_seq.append((version, seq))
             for tag, muts in messages.items():
                 if muts:
-                    self.messages.setdefault(tag, deque()).append((version, muts))
-                    w = sum(m.weight() for m in muts)
+                    w = mutations_weight(muts)
+                    self.messages.setdefault(tag, deque()).append(
+                        (version, muts, w))
                     self._mem_bytes += w
                     self._tag_sizes.setdefault(tag, deque()).append((version, w))
                     self._tag_bytes[tag] = self._tag_bytes.get(tag, 0) + w

@@ -21,6 +21,8 @@ Ranges are half-open [begin, end) like the reference's KeyRangeRef.
 
 from __future__ import annotations
 
+from bisect import bisect_right as _bisect_right
+
 import numpy as np
 
 KEY_BYTES = 24
@@ -126,5 +128,4 @@ def partition_index(boundaries: list[bytes], key: bytes) -> int:
     """Index of the partition owning `key` for sorted begin-boundaries
     (boundaries[0] == b""). Shared by shard maps, resolver maps, and the
     client location cache so ownership can never diverge between them."""
-    import bisect
-    return max(0, bisect.bisect_right(boundaries, key) - 1)
+    return max(0, _bisect_right(boundaries, key) - 1)

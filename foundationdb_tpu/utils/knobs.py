@@ -95,6 +95,13 @@ KNOBS.init("GRV_BATCH_INTERVAL", 0.0005, (0.01,))
 KNOBS.init("READ_BATCH_INTERVAL", 0.0005, (0.01,))  # point-read batcher
 KNOBS.init("READ_BATCH_MAX", 250, (2,))  # smaller batches pipeline better
 KNOBS.init("DEFAULT_BACKOFF", 0.01, (1.0,))
+# load balance (fdbrpc/LoadBalance.actor.h:159 + QueueModel): replicas are
+# ordered by smoothed latency, and a duplicate "backup request" goes to the
+# next-best replica once the first has been in flight MULT x its expected
+# latency (floored) — the tail-latency hedge for one slow/clogged replica
+KNOBS.init("LOAD_BALANCE_EWMA_ALPHA", 0.2)
+KNOBS.init("LOAD_BALANCE_BACKUP_MULT", 5.0, (1.0,))
+KNOBS.init("LOAD_BALANCE_MIN_BACKUP_DELAY", 0.005, (0.0005,))
 KNOBS.init("MAX_BACKOFF", 1.0)
 KNOBS.init("KEY_SIZE_LIMIT", 10_000)
 KNOBS.init("VALUE_SIZE_LIMIT", 100_000)

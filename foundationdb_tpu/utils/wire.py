@@ -82,10 +82,33 @@ def _install_native():
     for tid, cls in _BY_ID.items():
         names = (tuple(f.name for f in _FIELDS[tid])
                  if tid in _FIELDS else None)
-        by_id[tid] = (cls, names)
+        # third slot: decode accelerator. Enums get a value -> member map
+        # (skips the metaclass __call__); vanilla dataclasses get True,
+        # licensing the C decoder to allocate + fill the instance dict
+        # directly instead of calling the generated __init__ (the pickle
+        # bypass — only sound when __init__ IS the generated assigner).
+        if isinstance(cls, type) and issubclass(cls, IntEnum):
+            extra = {int(m.value): m for m in cls}
+        elif names is not None and _plain_dataclass(cls):
+            extra = True
+        else:
+            extra = None
+        by_id[tid] = (cls, names, extra)
         by_type[cls] = tid
     native.mod.wire_set_registry(by_id, by_type)
     _native = native.mod
+
+
+def _plain_dataclass(cls: type) -> bool:
+    """True when constructing == assigning each field: the dataclass's own
+    generated __init__ (co_filename "<string>"), every field in init, and
+    no __post_init__ / __slots__ hooks that the bypass would skip."""
+    init = cls.__dict__.get("__init__")
+    code = getattr(init, "__code__", None)
+    return (code is not None and code.co_filename == "<string>"
+            and not hasattr(cls, "__post_init__")
+            and "__slots__" not in cls.__dict__
+            and all(f.init for f in fields(cls)))
 
 
 def register(type_id: int, cls: type):
@@ -428,7 +451,32 @@ def _decode_value(data: bytes, pos: int, end: int,
     raise WireError(f"unknown tag {tag:#x}")
 
 
+class PreEncoded:
+    """A reply already serialized to a complete wire frame (the storage
+    server's C read path emits these). dumps() passes the bytes through
+    untouched, so the frame must decode to the reply dataclass it stands
+    for — producers are parity-tested against _py_dumps. Only handlers
+    that saw `wants_bytes` on the reply promise may send one; in-process
+    deliveries hand the payload object to the caller unserialized, where
+    a PreEncoded would be a type error."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+def type_id(cls: type) -> int:
+    """Registered wire id for `cls` (stable across processes — ids are
+    pinned in _register_all). Native encoders take this id to emit frames
+    without touching the registry."""
+    _ensure_registry()
+    return _registered_id(cls)
+
+
 def dumps(obj) -> bytes:
+    if type(obj) is PreEncoded:
+        return obj.data
     _ensure_registry()
     if _native is not None:
         try:

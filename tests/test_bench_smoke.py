@@ -1,0 +1,42 @@
+"""Smoke test for the e2e bench driver: a ~2-second slice of every phase on
+both topologies (merged and proxy fan-out) must complete and emit a
+well-formed report. Guards the measurement harness itself — a broken
+bench_e2e.py otherwise goes unnoticed until a round's official run.
+
+Numbers from these slices are meaningless (tiny load, shared CI core); only
+shape and completion are asserted.
+"""
+
+import json
+
+import pytest
+
+import bench_e2e
+
+_PHASES = ("write", "read", "mixed")
+
+
+def _check_report(report: dict, n_proxies: int):
+    # JSON round-trip: the official run is consumed as BENCH_rNN.json
+    decoded = json.loads(json.dumps(report))
+    assert decoded["topology"] == {"proxies": n_proxies, "storage": 1,
+                                   "client_procs": 1}
+    assert decoded["conflict_backend"] == "oracle"
+    for kind in _PHASES:
+        entry = decoded[kind]
+        assert entry["ops_per_sec"] > 0, (kind, entry)
+        assert entry["vs_baseline"] > 0
+        assert entry["ops_per_sec"] / bench_e2e.BASELINES[kind] == \
+            pytest.approx(entry["vs_baseline"], abs=1e-3)
+        # every phase awaits GRV; write and mixed phases commit
+        assert "grv_ms_p50" in entry
+        if kind != "read":
+            assert "commit_ms_p50" in entry and "commit_ms_p99" in entry
+
+
+@pytest.mark.parametrize("n_proxies", [0, 2], ids=["merged", "fanout2"])
+def test_bench_slice(n_proxies):
+    report = bench_e2e.run(clients=40, seconds=0.5, backend="oracle",
+                           n_proxies=n_proxies, n_storage=1,
+                           n_client_procs=1)
+    _check_report(report, n_proxies)

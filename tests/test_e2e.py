@@ -502,3 +502,105 @@ def test_wrong_shard_server_rejected():
             assert e.name == "wrong_shard_server"
 
     c.run(c.loop.spawn(t()), max_time=5_000.0)
+
+
+def test_get_many_matches_individual_gets():
+    """tr.get_many returns the same values, in order, as per-key gets —
+    on both its paths: the batched fast path (read version known, no
+    overlay) and the composed get_future path (no read version yet)."""
+    c = make_cluster()
+    db = c.database()
+    keys = [b"gm%02d" % i for i in range(8)]
+
+    async def t():
+        async def setup(tr):
+            for i, k in enumerate(keys):
+                tr.set(k, b"v%02d" % i)
+        await db.transact(setup)
+
+        probe = keys + [b"gm-missing"]
+        expect = [b"v%02d" % i for i in range(8)] + [None]
+
+        tr = db.create_transaction()
+        assert await tr.get_many(probe) == expect  # no GRV yet: composed
+
+        tr2 = db.create_transaction()
+        await tr2.get_read_version()
+        assert await tr2.get_many(probe) == expect  # batched fast path
+        assert await tr2.get_many([]) == []
+
+    c.run(c.loop.spawn(t()), max_time=5_000.0)
+
+
+def test_get_many_sees_uncommitted_writes():
+    c = make_cluster()
+    db = c.database()
+
+    async def t():
+        async def setup(tr):
+            tr.set(b"a", b"old")
+            tr.set(b"b", b"keep")
+        await db.transact(setup)
+
+        tr = db.create_transaction()
+        await tr.get_read_version()
+        tr.set(b"a", b"new")
+        tr.clear(b"b")
+        assert await tr.get_many([b"a", b"b", b"c"]) == [b"new", None, None]
+
+    c.run(c.loop.spawn(t()), max_time=5_000.0)
+
+
+def test_get_many_adds_read_conflicts():
+    """A non-snapshot multiget must conflict with a concurrent write to any
+    of its keys; a snapshot multiget must not."""
+    c = make_cluster()
+    db = c.database()
+    outcome = {}
+
+    async def t():
+        t1 = db.create_transaction()
+        await t1.get_read_version()
+        await t1.get_many([b"k1", b"k2"])
+        t2 = db.create_transaction()
+        t2.set(b"k2", b"other")
+        await t2.commit()
+        t1.set(b"unrelated", b"x")
+        try:
+            await t1.commit()
+            outcome["t1"] = "committed"
+        except FDBError as e:
+            outcome["t1"] = e.name
+
+        t3 = db.create_transaction()
+        await t3.get_read_version()
+        await t3.get_many([b"k1", b"k2"], snapshot=True)
+        t4 = db.create_transaction()
+        t4.set(b"k1", b"again")
+        await t4.commit()
+        t3.set(b"unrelated2", b"y")
+        await t3.commit()  # would abort if snapshot reads conflicted
+
+    c.run(c.loop.spawn(t()), max_time=5_000.0)
+    assert outcome["t1"] == "not_committed"
+
+
+def test_get_many_across_shards():
+    """A multiget whose keys live on different storage teams decomposes into
+    per-team reads and reassembles in order (the _send_read_batches split)."""
+    c = make_cluster(n_storage=4, n_tlogs=2)
+    db = c.database()
+    keys = [bytes([16 * i]) + b"/gm%02d" % i for i in range(16)]
+
+    async def t():
+        async def setup(tr):
+            for i, k in enumerate(keys):
+                tr.set(k, b"v%02d" % i)
+        await db.transact(setup)
+
+        tr = db.create_transaction()
+        await tr.get_read_version()
+        got = await tr.get_many(keys + [b"\x55missing"])
+        assert got == [b"v%02d" % i for i in range(16)] + [None]
+
+    c.run(c.loop.spawn(t()), max_time=5_000.0)

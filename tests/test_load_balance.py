@@ -1,0 +1,90 @@
+"""Client read load balance: latency-aware replica choice + backup requests.
+
+Reference: fdbrpc/LoadBalance.actor.h:159 — loadBalance sends the first
+request to the best replica per the QueueModel and a duplicate "backup
+request" to the next alternative once the first has been in flight longer
+than its expected latency; fdbrpc/QueueModel.h smooths per-replica latency.
+
+The headline test clogs ONE replica of a 2-replica team for the whole run:
+with random-first-replica every other read would stall behind the clog
+(read p99 ~ clog duration); with the EWMA model + hedging the p99 must
+collapse to a few backup-delays.
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.client.database import ReplicaStats
+from foundationdb_tpu.server.cluster import RecoverableCluster
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+    KNOBS.reset()
+
+
+def test_replica_stats_ordering():
+    stats = ReplicaStats()
+    rng = random.Random(7)
+    for _ in range(20):
+        stats.record("fast", 0.001)
+        stats.record("slow", 0.5)
+    # jitter is ±20%, a 500x gap cannot flip the order
+    for _ in range(50):
+        assert stats.order(["slow", "fast"], rng)[0] == "fast"
+    # unknown replicas inherit the best estimate: they stay competitive
+    order = stats.order(["slow", "fresh", "fast"], rng)
+    assert order.index("fresh") < order.index("slow")
+
+
+def test_replica_stats_ewma_converges():
+    stats = ReplicaStats()
+    stats.record("a", 1.0)
+    for _ in range(60):
+        stats.record("a", 0.002)
+    assert stats.expected("a", 0.0) < 0.01  # forgot the cold-start spike
+
+
+def test_clogged_replica_read_p99_collapses():
+    """One clogged replica must not poison the read tail: the first slow
+    encounter triggers a backup request (hedge), the EWMA then routes
+    everything to the healthy replica, and read p99 stays orders of
+    magnitude below the clog delay."""
+    c = RecoverableCluster(seed=53, n_workers=4, n_proxies=1, n_tlogs=1,
+                           n_storage=1, n_replicas=2, n_storage_workers=2)
+    db = c.database()
+    latencies: list[float] = []
+
+    async def t():
+        await db.refresh()
+
+        async def setup(tr):
+            for i in range(10):
+                tr.set(b"lb%02d" % i, b"v%02d" % i)
+        await db.transact(setup)
+
+        team, _end = db.locations.locate(b"lb00")
+        assert len(team) == 2, f"expected a 2-replica team, got {team}"
+        # clog the client <-> replica link for the entire test: every read
+        # sent there waits ~clog seconds (sim clogs delay, not drop)
+        c.net.clog_pair(db.process.address, team[0], 600.0)
+
+        for i in range(120):
+            t0 = c.loop.now()
+            tr = db.create_transaction()
+            v = await tr.get(b"lb%02d" % (i % 10))
+            assert v == b"v%02d" % (i % 10)
+            latencies.append(c.loop.now() - t0)
+
+    c.run(c.loop.spawn(t()), max_time=30_000.0)
+
+    latencies.sort()
+    p99 = latencies[int(len(latencies) * 0.99)]
+    # random-first-replica would put ~half the reads behind the clog
+    # (p50 ~ minutes); hedged + EWMA-routed reads finish in milliseconds
+    assert p99 < 0.25, f"read p99 {p99:.3f}s did not collapse: {latencies[-5:]}"
+    assert latencies[len(latencies) // 2] < 0.05, "median read slow"
