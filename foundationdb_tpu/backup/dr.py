@@ -165,15 +165,26 @@ class DRAgent:
 
     async def run(self, poll: float = 0.5):
         """Continuous tail: drain until the DR is deactivated AND the log is
-        empty (every tee'd mutation reached the destination)."""
+        empty (every tee'd mutation reached the destination).
+
+        dr_agent is a daemon: a dead storage server or a recovery on either
+        cluster surfaces as a transient FDBError mid-drain, and the agent
+        must ride it out and resume — drain application is idempotent
+        (watermark in the destination), so re-running a failed drain is
+        always safe."""
         while True:
-            moved = await self.drain_once()
-            if moved == 0:
-                async def st(tr):
-                    return await tr.get(STATE_KEY)
-                state = await self.src.transact(st, max_retries=200)
-                if state != b"active":
-                    return
+            try:
+                moved = await self.drain_once()
+                if moved == 0:
+                    async def st(tr):
+                        return await tr.get(STATE_KEY)
+                    state = await self.src.transact(st, max_retries=200)
+                    if state != b"active":
+                        return
+                    await self.loop.delay(poll)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
                 await self.loop.delay(poll)
 
     async def applied_version(self) -> int:

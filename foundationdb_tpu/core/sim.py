@@ -203,6 +203,15 @@ class SimNetwork:
         if p.boot_fn is not None:
             p.boot_fn(p)
 
+    def reboot_dead(self, addresses=None):
+        """Reboot every dead process (optionally restricted to `addresses`)
+        — the heal path shared by the spec runner's quiesce, region-kill
+        workloads, and whole-cluster restart tests."""
+        wanted = None if addresses is None else set(addresses)
+        for p in list(self.processes.values()):
+            if not p.alive and (wanted is None or p.address in wanted):
+                self.reboot(p.address)
+
     # -- file API --
     def open_file(self, process: SimProcess, name: str) -> SimFile:
         if name not in process.files:

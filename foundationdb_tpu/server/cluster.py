@@ -249,6 +249,23 @@ class RecoverableCluster:
             if p.dc_id == dc_id and p.alive:
                 self.net.kill(p.address)
 
+    def cluster_procs(self) -> list[SimProcess]:
+        """Every process that IS the cluster (coordinators + workers +
+        storage workers) — excludes client processes living on the same
+        simulated network."""
+        return self.coord_procs + self.worker_procs + self.storage_worker_procs
+
+    def restart_from_disk(self):
+        """Whole-cluster restart (tests/restarting/*.txt): every cluster
+        process dies at once; each reboots onto its surviving durable files
+        and the cluster must re-elect, re-recover, and serve the same data.
+        Unsynced tails are (deterministically-randomly) torn, exactly like a
+        power loss."""
+        from foundationdb_tpu.core.sim import KillType
+        for p in self.cluster_procs():
+            if p.alive:
+                self.net.kill(p.address, KillType.RebootProcess)
+
     def database(self, name: str = "client:0") -> Database:
         proc = self.net.processes.get(name) or self.net.new_process(name)
         return Database(proc, coordinators=self.coordinators,

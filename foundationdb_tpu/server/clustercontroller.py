@@ -153,7 +153,9 @@ class ClusterController:
                 and req.address not in {a for a, _t in info.storages}):
             self.net.one_way(self.process,
                              Endpoint(req.address, Token.STORAGE_SET_SHARDS),
-                             SetShardsRequest(shard_ranges=[]))
+                             SetShardsRequest(shard_ranges=[],
+                                              layout_version=(info.epoch,
+                                                              info.version)))
 
     def _on_get_dbinfo(self, req, reply):
         reply.send(self.dbinfo)
@@ -1265,8 +1267,13 @@ class ClusterController:
         new_teams = [list(t) for t in teams]
         new_teams[i] = new_team
         await self._publish_layout(b, new_teams, storages=new_storages)
-        # serving ranges for every member of the updated team
-        self._push_team_ranges(new_team, b, new_teams, addr_of_tag)
+        # serving ranges for every OLD member too, not just the new team: a
+        # drained-but-alive member (exclusion heals look exactly like dead-
+        # server heals) must drop the range, or a later move back onto it
+        # would look like a duplicate and skip the re-fetch — serving every
+        # write since the drain from a stale replica
+        self._push_team_ranges(sorted(set(team) | {new_tag}), b, new_teams,
+                               addr_of_tag)
         return True
 
     async def _shrink_team(self, info, i: int, want: int) -> bool:
@@ -1365,6 +1372,7 @@ class ClusterController:
                 for j, t in enumerate(teams) if tag in t]
 
     def _push_team_ranges(self, team, boundaries, teams, addr_of_tag):
+        lv = (self.dbinfo.epoch, self.dbinfo.version)
         for tag in team:
             if addr_of_tag.get(tag) is None:
                 continue
@@ -1372,7 +1380,8 @@ class ClusterController:
                 self.process,
                 Endpoint(addr_of_tag[tag], Token.STORAGE_SET_SHARDS),
                 SetShardsRequest(
-                    shard_ranges=self._tag_ranges(tag, boundaries, teams)))
+                    shard_ranges=self._tag_ranges(tag, boundaries, teams),
+                    layout_version=lv))
 
     async def _publish_layout(self, new_b, new_teams, storages=None):
         """Shared publish step for every DD layout change: the coordinated

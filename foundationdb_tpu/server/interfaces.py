@@ -370,9 +370,15 @@ class AddShardRequest:
 
 @dataclass
 class SetShardsRequest:
-    """Replace the served ranges (MoveKeys source side after the handoff)."""
+    """Replace the served ranges (MoveKeys source side after the handoff).
+
+    layout_version orders pushes: SET_SHARDS travels one_way, and a clogged
+    link delays (and can reorder) packets — a stale assignment arriving after
+    a newer one must not resurrect ranges the server no longer receives
+    mutations for. None (direct tests) always applies."""
 
     shard_ranges: list  # list[(begin, end|None)]
+    layout_version: tuple | None = None  # (epoch, DBInfo.version) at push
 
 
 @dataclass

@@ -72,6 +72,12 @@ class StorageServer:
         # stale location cache re-resolves (storageserver getValueQ's
         # serveGetValueRequests shard check).
         self.shard_ranges = shard_ranges
+        self._layout_version = None  # last SET_SHARDS (epoch, version) applied
+        # fence of the move that installed each range: a re-add with a HIGHER
+        # fence is a new move onto a server that may have missed the range's
+        # mutations since (an exclusion drained it, then it was included
+        # back) and must re-fetch; only a retry of the SAME move may skip
+        self._shard_fences: dict = {}
         # engine selection (openKVStore dispatch IKeyValueStore.h:66,
         # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
         # WAL (kill-injected durability faults); "ssd" = host B-tree over
@@ -95,6 +101,11 @@ class StorageServer:
                       f"-{process.address.replace(':', '_')}"
                       f"-storage-{tag}.sqlite")
             self.store = open_kv_store(self.engine, path=path)
+            # the data lives in a host file, invisible to the sim process's
+            # file table — register a marker sim file so worker reboot
+            # detection (any file named storage-*) re-attaches this role
+            # after a whole-cluster restart, same as the memory engine's WAL
+            process.net.open_file(process, f"storage-{tag}.ssd")
         self.store.recover()
         meta = self.store.get_metadata(_DURABLE_VERSION_KEY)
         self.durable_version = max(
@@ -219,6 +230,12 @@ class StorageServer:
         reply.send(out)
 
     def _on_set_shards(self, req: SetShardsRequest, reply):
+        lv = getattr(req, "layout_version", None)
+        if lv is not None:
+            if self._layout_version is not None and lv < self._layout_version:
+                reply.send(None)  # clog-delayed stale push: ignore
+                return
+            self._layout_version = lv
         self.shard_ranges = [tuple(r) for r in req.shard_ranges]
         reply.send(None)
 
@@ -240,8 +257,10 @@ class StorageServer:
         instead of pausing — an optimization, not a correctness difference.
         """
         from foundationdb_tpu.core.future import Future
-        if (req.begin, req.end) in (self.shard_ranges or []):
-            reply.send(self.version.get())  # duplicate/retried move: done
+        if ((req.begin, req.end) in (self.shard_ranges or [])
+                and req.fence_version <= self._shard_fences.get(
+                    (req.begin, req.end), -1)):
+            reply.send(self.version.get())  # retry of the SAME move: done
             return
         if self._ingest_gate is not None:
             # one splice at a time: a second concurrent fetch would clobber
@@ -310,8 +329,10 @@ class StorageServer:
             for m in muts:
                 self.data.apply(c0, m)
             self._pending_durable.append((c0, muts))
-            self.shard_ranges = (self.shard_ranges or []) + [(req.begin,
-                                                              req.end)]
+            if (req.begin, req.end) not in (self.shard_ranges or []):
+                self.shard_ranges = (self.shard_ranges or []) + [(req.begin,
+                                                                  req.end)]
+            self._shard_fences[(req.begin, req.end)] = req.fence_version
             reply.send(c0)
         except FDBError as e:
             reply.send_error(e)

@@ -32,6 +32,19 @@ class Worker:
         # (worker.actor.cpp: storage servers restore from disk at startup)
         if any(name.startswith("storage-") for name in process.files):
             process.spawn(self._restore_storage(), "restoreStorage")
+        # tlog DiskQueue files re-create their generations immediately
+        # (TLogServer restorePersistentState): the next master must be able
+        # to LOCK and peek the old generation, or a whole-cluster restart
+        # wedges on "cannot lock enough old TLogs"
+        tlog_uids = sorted({name[len("tlog-"):-len(".dq.0")]
+                            for name in process.files
+                            if name.startswith("tlog-")
+                            and name.endswith(".dq.0")})
+        if tlog_uids:
+            from foundationdb_tpu.server.tlog import TLogHost
+            host = self.roles["tloghost"] = TLogHost(process)
+            for uid in tlog_uids:
+                host.add(uid=uid).recover_from_file()
 
     # -- liveness (waitFailureServer analogue) --
 

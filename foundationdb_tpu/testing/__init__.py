@@ -5,3 +5,13 @@ from foundationdb_tpu.testing.workloads import (  # noqa: F401
     SelectorCorrectnessWorkload, SwizzleCloggingWorkload,
     VersionStampWorkload, WatchesWorkload, WriteDuringReadWorkload,
     run_spec)
+
+from foundationdb_tpu.testing.fuzz_workloads import (  # noqa: F401
+    BackupUnderAttritionWorkload, ChangeConfigWorkload,
+    FuzzApiCorrectnessWorkload, KillRegionWorkload,
+    RemoveServersSafelyWorkload, RyowCorrectnessWorkload,
+    SerializabilityWorkload)
+
+from foundationdb_tpu.testing.simulated_cluster import (  # noqa: F401
+    FAST_SPECS, SLOW_SPECS, SPECS, ClusterDraw, RandomizedResult, Spec,
+    SpecFailure, run_randomized_spec, sweep)

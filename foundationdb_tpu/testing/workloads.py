@@ -283,11 +283,17 @@ class SpecResult:
 
 def run_spec(seed: int, workloads: list[Workload] | None = None,
              duration: float = 60.0, buggify: bool = True,
-             max_time: float = 600_000.0, **cluster_kw) -> SpecResult:
+             max_time: float = 600_000.0, cluster_factory=None,
+             **cluster_kw) -> SpecResult:
     """Boot a RecoverableCluster, run `workloads` in parallel for `duration`
     virtual seconds, quiesce (heal + wait for a recovered generation), then
     run every workload's check(). The whole run is a pure function of
     (seed, spec): the reference's `fdbserver -r simulation -f spec.txt`.
+
+    `cluster_factory(cluster_seed) -> RecoverableCluster` overrides the
+    default flat topology — the randomized harness (testing/simulated_cluster)
+    uses it to boot whatever shape the seed drew, including two-region
+    clusters built via RecoverableCluster.two_region().
     """
     from foundationdb_tpu.server.cluster import RecoverableCluster
     from foundationdb_tpu.utils.rng import DeterministicRandom
@@ -299,11 +305,14 @@ def run_spec(seed: int, workloads: list[Workload] | None = None,
         workloads = [CycleWorkload(), RandomCloggingWorkload(),
                      AttritionWorkload()]
 
-    cluster_kw.setdefault("n_workers", 5)
-    cluster_kw.setdefault("n_proxies", 2)
-    cluster_kw.setdefault("n_tlogs", 2)
-    cluster_kw.setdefault("n_storage", 2)
-    c = RecoverableCluster(seed=rng.randint(0, 1 << 30), **cluster_kw)
+    if cluster_factory is not None:
+        c = cluster_factory(rng.randint(0, 1 << 30))
+    else:
+        cluster_kw.setdefault("n_workers", 5)
+        cluster_kw.setdefault("n_proxies", 2)
+        cluster_kw.setdefault("n_tlogs", 2)
+        cluster_kw.setdefault("n_storage", 2)
+        c = RecoverableCluster(seed=rng.randint(0, 1 << 30), **cluster_kw)
     db = c.database()
 
     async def spec():
@@ -318,7 +327,7 @@ def run_spec(seed: int, workloads: list[Workload] | None = None,
         # quiesce (QuietDatabase): heal every fault, then wait until a CC
         # reaches accepting_commits and transactions flow again
         c.net.heal()
-        for p in c.worker_procs + c.storage_worker_procs + c.coord_procs:
+        for p in c.cluster_procs():
             if not p.alive:
                 c.net.reboot(p.address)
         for _ in range(600):
@@ -414,21 +423,30 @@ class ConsistencyCheckWorkload(Workload):
 
 class ConflictRangeWorkload(Workload):
     """System-level RESOLVER ORACLE (fdbserver/workloads/ConflictRange.actor.cpp):
-    transaction A reads a random range; transaction B then commits
-    writes/clears at random keys; A commits a write of its own. A's outcome
-    is forced: not_committed iff B touched A's read range, committed
-    otherwise. Every verdict cross-checks the whole conflict pipeline —
-    client conflict-range registration, proxy range splitting, and the
-    device/sharded/oracle engine's decision — against an independent
-    host-side expectation."""
+    transaction A performs 1-3 randomized range reads (random shapes,
+    optionally LIMITED and/or REVERSE — the registered conflict range is then
+    clipped to the window actually observed — optionally SNAPSHOT, which
+    registers nothing); transaction B then commits a random plan of
+    sets/clears/range-clears; A commits a write of its own. A's outcome is
+    forced: not_committed iff B touched a window A actually registered,
+    committed otherwise — snapshot reads are exempt, and keys beyond a
+    limit-clipped window are exempt. Every verdict cross-checks the whole
+    conflict pipeline — client conflict-range registration (including the
+    clipping), proxy range splitting, and the device/sharded/oracle engine's
+    decision — against an independent host model, which also validates every
+    range read's row set."""
 
     name = "ConflictRange"
 
-    def __init__(self, n_keys: int = 40, prefix: bytes = b"cr/"):
+    def __init__(self, n_keys: int = 48, prefix: bytes = b"cr/"):
         self.n = n_keys
         self.prefix = prefix
+        self.present: set[int] = set()
         self.checked = 0
         self.conflicts = 0
+        self.snapshot_exempt = 0   # B touched a snapshot read: no conflict
+        self.clip_exempt = 0       # B touched only beyond a clipped window
+        self.clipped_reads = 0
 
     def key(self, i: int) -> bytes:
         return self.prefix + b"%04d" % i
@@ -438,58 +456,161 @@ class ConflictRangeWorkload(Workload):
             for i in range(0, self.n, 2):
                 tr.set(self.key(i), b"v%04d" % i)
         await db.transact(fn)
+        self.present = set(range(0, self.n, 2))
+
+    # -- draw helpers (all randomness from self.rng: replayable) --
+
+    def _draw_reads(self, rng):
+        """1-3 range-read shapes: (lo_i, hi_i, limit, reverse). A limit is
+        only drawn strictly below the number of rows present, so the client
+        is guaranteed to clip its registered conflict range."""
+        reads = []
+        for _ in range(rng.randint(1, 3)):
+            lo_i = rng.randint(0, self.n - 2)
+            hi_i = rng.randint(lo_i + 1, self.n)
+            avail = sum(1 for i in self.present if lo_i <= i < hi_i)
+            limit = 0
+            if avail >= 2 and rng.coinflip(0.4):
+                limit = rng.randint(1, avail - 1)
+            reads.append((lo_i, hi_i, limit, rng.coinflip(0.3)))
+        return reads
+
+    def _draw_plan(self, rng):
+        """B's mutation plan, fixed up front so transact() retries replay
+        identical (idempotent) mutations."""
+        plan = []
+        for _ in range(rng.randint(1, 4)):
+            r = rng.random()
+            if r < 0.5:
+                plan.append(("set", rng.randint(0, self.n - 1),
+                             rng.randint(0, 1 << 30)))
+            elif r < 0.8:
+                plan.append(("clear", rng.randint(0, self.n - 1), 0))
+            else:
+                i = rng.randint(0, self.n - 2)
+                plan.append(("clear_range", i, rng.randint(i + 1, self.n)))
+        return plan
+
+    def _apply_plan(self, plan):
+        for kind, a, b in plan:
+            if kind == "set":
+                self.present.add(a)
+            elif kind == "clear":
+                self.present.discard(a)
+            else:
+                for i in [i for i in self.present if a <= i < b]:
+                    self.present.discard(i)
+
+    def _plan_touches(self, plan, lo: bytes, hi: bytes) -> bool:
+        for kind, a, b in plan:
+            if kind == "clear_range":
+                if self.key(a) < hi and lo < self.key(b):
+                    return True
+            elif lo <= self.key(a) < hi:
+                return True
+        return False
+
+    def _registered_window(self, lo, hi, limit, reverse, rows):
+        """Mirror of Transaction.get_range's conflict registration: a
+        satisfied limit clips the window to the span actually observed."""
+        if limit and len(rows) == limit:
+            self.clipped_reads += 1
+            if reverse:
+                return (rows[-1][0], hi)
+            return (lo, rows[-1][0] + b"\x00")
+        return (lo, hi)
+
+    async def _resync(self, db):
+        """B's fate unknown (retry budget exhausted): reload the key model
+        from the database before judging any further verdicts."""
+        async def rd(tr):
+            return await tr.get_range(self.key(0), self.key(self.n),
+                                      limit=self.n + 1)
+        rows = await db.transact(rd, max_retries=500)
+        self.present = {int(k[len(self.prefix):]) for k, _v in rows}
 
     async def start(self, db):
         it = 0
         while self._time_left():
             it += 1
             rng = self.rng
-            lo_i = rng.randint(0, self.n - 2)
-            hi_i = rng.randint(lo_i + 1, self.n)
-            lo, hi = self.key(lo_i), self.key(hi_i)
-            # B's plan is fixed up front so its transact() retries replay
-            # the identical (idempotent) mutations
-            plan = [(rng.randint(0, self.n - 1), rng.coinflip(0.5),
-                     rng.randint(0, 1 << 30))
-                    for _ in range(rng.randint(1, 4))]
-            touches = any(lo_i <= k < hi_i for k, _s, _v in plan)
-            token = b"t%08d" % it
+            snapshot = rng.coinflip(0.2)
+            reads = self._draw_reads(rng)
+            plan = self._draw_plan(rng)
             marker = self.prefix + b"__marker__"
+            token = b"t%08d" % it
             trA = db.create_transaction()
+            windows = []
+            b_touched_any_read = False
             try:
                 await trA.get_read_version()
-                await trA.get_range(lo, hi)
-
-                async def bfn(tr):
-                    for k, is_set, v in plan:
-                        if is_set:
-                            tr.set(self.key(k), b"b%08d" % v)
-                        else:
-                            tr.clear(self.key(k))
-                await db.transact(bfn, max_retries=500)
-
-                trA.set(marker, token)
-                try:
-                    await trA.commit()
-                    committed = True
-                except FDBError as e:
-                    if e.name == "not_committed":
-                        committed = False
-                    elif e.name == "commit_unknown_result":
-                        async def probe(tr):
-                            return await tr.get(marker)
-                        committed = (await db.transact(probe, max_retries=500)
-                                     == token)
-                    else:
-                        continue  # infrastructure noise: no verdict
+                for lo_i, hi_i, limit, reverse in reads:
+                    lo, hi = self.key(lo_i), self.key(hi_i)
+                    rows = await trA.get_range(lo, hi, limit=limit,
+                                               reverse=reverse,
+                                               snapshot=snapshot)
+                    want = [self.key(i) for i in sorted(self.present)
+                            if lo_i <= i < hi_i]
+                    if reverse:
+                        want = want[::-1]
+                    if limit:
+                        want = want[:limit]
+                    got = [k for k, _v in rows]
+                    assert got == want, \
+                        (f"getRange[{lo_i},{hi_i}) limit={limit} "
+                         f"reverse={reverse} diverges from model: "
+                         f"{got} vs {want}")
+                    if self._plan_touches(plan, lo, hi):
+                        b_touched_any_read = True
+                    windows.append(self._registered_window(
+                        lo, hi, limit, reverse, rows))
             except FDBError:
-                continue  # clog/recovery noise: no verdict
+                continue  # clog/recovery noise before B ran: no verdict
+            # B commits its plan (idempotent; transact retries replay it)
+            async def bfn(tr, plan=plan):
+                for kind, a, b, in plan:
+                    if kind == "set":
+                        tr.set(self.key(a), b"b%08d" % b)
+                    elif kind == "clear":
+                        tr.clear(self.key(a))
+                    else:
+                        tr.clear_range(self.key(a), self.key(b))
+            try:
+                await db.transact(bfn, max_retries=500)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                await self._resync(db)
+                continue
+            self._apply_plan(plan)
+            # expectation: conflict iff B touched a REGISTERED window
+            touches = (not snapshot) and any(
+                self._plan_touches(plan, wl, wh) for wl, wh in windows)
+            trA.set(marker, token)
+            try:
+                await trA.commit()
+                committed = True
+            except FDBError as e:
+                if e.name == "not_committed":
+                    committed = False
+                elif e.name == "commit_unknown_result":
+                    async def probe(tr):
+                        return await tr.get(marker)
+                    committed = (await db.transact(probe, max_retries=500)
+                                 == token)
+                else:
+                    continue  # infrastructure noise: no verdict
             assert committed == (not touches), \
-                (f"resolver verdict wrong: B touched A's range={touches}, "
-                 f"A committed={committed} (iter {it}, range "
-                 f"[{lo_i},{hi_i}), plan {plan})")
+                (f"resolver verdict wrong: B touched A's registered "
+                 f"range={touches}, A committed={committed} (iter {it}, "
+                 f"snapshot={snapshot}, reads {reads}, plan {plan})")
             self.checked += 1
             self.conflicts += 0 if committed else 1
+            if committed and snapshot and b_touched_any_read:
+                self.snapshot_exempt += 1
+            if committed and not snapshot and b_touched_any_read:
+                # touched a read but no registered window: clip exemption
+                self.clip_exempt += 1
 
     async def check(self, db):
         assert self.checked > 0, "no conflict-range verdicts were checked"

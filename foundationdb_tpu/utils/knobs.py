@@ -47,11 +47,25 @@ class Knobs:
         for k, d in self._defs.items():
             self._values[k] = d.default
 
-    def buggify(self, rng, probability: float = 0.25):
-        """Randomly set knobs that declare extremes (deterministic under rng)."""
+    def draw_buggified(self, rng, probability: float = 0.25) -> dict[str, Any]:
+        """PURE draw of a buggified knob subset (deterministic under rng):
+        which knobs would be randomized and to what, without applying them.
+        The randomized harness records this draw in its repro line — the
+        knob draw is part of the environment a failing seed must replay
+        (SimulatedCluster's per-seed knob randomization, flow/Knobs.cpp
+        BUGGIFY pattern)."""
+        drawn: dict[str, Any] = {}
         for k, d in sorted(self._defs.items()):
             if d.extremes and rng.random() < probability:
-                self._values[k] = d.extremes[rng.randint(0, len(d.extremes) - 1)]
+                drawn[k] = d.extremes[rng.randint(0, len(d.extremes) - 1)]
+        return drawn
+
+    def buggify(self, rng, probability: float = 0.25) -> dict[str, Any]:
+        """Randomly set knobs that declare extremes (deterministic under
+        rng). Returns the drawn subset {name: buggified_value}."""
+        drawn = self.draw_buggified(rng, probability)
+        self._values.update(drawn)
+        return drawn
 
     def overrides(self, **kw):
         for k, v in kw.items():

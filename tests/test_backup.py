@@ -244,3 +244,110 @@ def test_fdbbackup_cli_commands(tmp_path):
     keys = dict(rows)
     assert keys.get(b"b99") == b"late"
     assert len([k for k in keys if k.startswith(b"b")]) == 21
+
+
+def test_backup_restore_under_fault_cocktail():
+    """The BackupUnderAttrition composition as a pinned spec: snapshot
+    chunks + the log tee keep streaming while the source's transaction
+    subsystem is clogged and killed/rebooted; the restore must still equal
+    the source at end version byte-for-byte."""
+    from foundationdb_tpu.core.sim import KillType
+    from foundationdb_tpu.utils.errors import FDBError
+    from foundationdb_tpu.utils.rng import DeterministicRandom
+    from foundationdb_tpu.utils.types import MutationType
+
+    src = RecoverableCluster(seed=43, n_workers=5, n_proxies=2, n_tlogs=2,
+                             n_storage=2, n_replicas=1)
+    db = src.database()
+    container = BackupContainer()
+    rng = DeterministicRandom(4302)
+
+    async def t():
+        await db.refresh(max_wait=120.0)
+
+        async def seed(tr):
+            for i in range(40):
+                tr.set(b"fc/%03d" % i, b"v%d" % i)
+        await db.transact(seed, max_retries=500)
+
+        agent = BackupAgent(db, container, chunks=3)
+        await agent.start()
+
+        state = {"stop": False}
+
+        async def writer():
+            n = 0
+            while not state["stop"]:
+                async def w(tr, n=n):
+                    tr.set(b"fc/live/%04d" % n, b"x%d" % n)
+                    tr.set(b"fc/%03d" % (n % 40), b"u%d" % n)
+                    if n % 5 == 0:
+                        tr.clear_range(b"fc/live/%04d" % max(0, n - 4),
+                                       b"fc/live/%04d" % max(1, n - 3))
+                    tr.atomic_op(MutationType.ADD_VALUE, b"fc/ctr",
+                                 (1).to_bytes(8, "little"))
+                try:
+                    await db.transact(w, max_retries=1000)
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                n += 1
+                await src.loop.delay(0.1)
+        wtask = src.loop.spawn(writer(), name="fcWriter")
+
+        # fault cocktail against the live stream: clog random links, kill
+        # (and auto-reboot) txn-subsystem workers — each kill forces a
+        # recovery the backup tee must survive
+        async def faults():
+            workers = [p.address for p in src.worker_procs]
+            everyone = workers + [p.address for p in src.storage_worker_procs]
+            for _ in range(6):
+                await src.loop.delay(1.5 + rng.random())
+                a = everyone[rng.randint(0, len(everyone) - 1)]
+                b = everyone[rng.randint(0, len(everyone) - 1)]
+                if a != b:
+                    src.net.clog_pair(a, b, 2.0 * rng.random())
+                if rng.coinflip(0.5):
+                    victim = workers[rng.randint(0, len(workers) - 1)]
+                    src.net.kill(victim, KillType.RebootProcess)
+        ftask = src.loop.spawn(faults(), name="fcFaults")
+
+        a1 = src.loop.spawn(agent.run_agent(), name="agent1")
+        tailer = src.loop.spawn(agent.run_log_tailer(), name="tailer")
+        await a1
+        await ftask
+        src.net.heal()
+        src.net.reboot_dead([p.address for p in src.cluster_procs()])
+        await src.loop.delay(1.0)
+
+        # quiesce the writer BEFORE stopping so the end version covers
+        # every landed write, then capture source truth at end version
+        state["stop"] = True
+        await wtask
+        end_version = await agent.stop()
+        await tailer
+
+        async def readall(tr):
+            tr._read_version = end_version
+            return await tr.get_range(b"", b"\xff")
+        return _user_rows(await db.transact(readall, max_retries=500))
+
+    truth = src.run(src.loop.spawn(t()), max_time=600_000.0)
+    assert len(truth) > 40, "fault cocktail starved the workload"
+
+    dst = SimCluster(seed=44, n_proxies=1, n_resolvers=1, n_tlogs=1,
+                     n_storage=2)
+    db2 = dst.database()
+
+    async def r():
+        await RestoreAgent(db2, container).restore()
+
+        async def readall(tr):
+            return await tr.get_range(b"", b"\xff")
+        return _user_rows(await db2.transact(readall, max_retries=200))
+
+    got = dst.run(dst.loop.spawn(r()), max_time=600_000.0)
+    assert got == truth, (
+        f"restore mismatch under faults: {len(got)} vs {len(truth)} rows; "
+        f"missing={set(dict(truth)) - set(dict(got))} "
+        f"extra={set(dict(got)) - set(dict(truth))}")

@@ -1,0 +1,111 @@
+"""Whole-cluster restart specs (the reference's tests/restarting/*.txt).
+
+The restarting tests are the only specs the reference runs as TWO fdbserver
+invocations: run half the workload, kill every process at once, restart the
+binaries on the surviving on-disk state, finish the workload, and check the
+invariant. Here both halves share one simulation — RecoverableCluster.
+restart_from_disk() kills every cluster process simultaneously (unsynced
+file tails torn, like a power loss), the processes reboot onto their durable
+files, and the cluster must re-elect, re-recover, and serve the same data.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.sim import KillType  # noqa: F401 — doc pointer
+from foundationdb_tpu.testing.workloads import (
+    ConsistencyCheckWorkload, CycleWorkload, quiet_database)
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _oracle_backend():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+
+
+async def _await_recovered(c, db, max_polls: int = 600):
+    """Wait until some CC reaches accepting_commits and a transaction lands
+    (run_spec's quiesce probe)."""
+    for _ in range(max_polls):
+        if c.current_cc() is not None:
+            try:
+                async def probe(tr):
+                    await tr.get(b"\x00restart-probe")
+                await db.transact(probe, max_retries=50)
+                return
+            except FDBError:
+                pass
+        await c.loop.delay(0.5)
+    raise AssertionError("cluster never re-recovered after restart")
+
+
+def _restart_spec(seed: int, engine: str, tmp_path, n_replicas: int = 1,
+                  n_storage_workers: int = None, half: float = 12.0):
+    """Half the workload -> whole-cluster restart from disk -> second half
+    -> quiesce -> invariant checks."""
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    from foundationdb_tpu.utils.rng import DeterministicRandom
+
+    KNOBS.set("STORAGE_ENGINE", engine)
+    KNOBS.set("SSD_DATA_DIR", str(tmp_path))
+    rng = DeterministicRandom(seed)
+    c = RecoverableCluster(seed=rng.randint(0, 1 << 30), n_workers=5,
+                           n_proxies=2, n_tlogs=2, n_storage=2,
+                           n_replicas=n_replicas,
+                           n_storage_workers=n_storage_workers)
+    db = c.database()
+    cyc = CycleWorkload()
+    cons = ConsistencyCheckWorkload()
+
+    async def scenario():
+        await db.refresh(max_wait=120.0)
+
+        # ---- first half ----
+        cyc.init(c, rng.fork(), c.loop.now() + half)
+        cons.init(c, rng.fork(), c.loop.now() + half)
+        await cyc.setup(db)
+        await cyc.start(db)
+        first_half = cyc.rotations
+        assert first_half > 0, "no progress before the restart"
+        # let the pipeline make the committed ring durable before pulling
+        # the plug (a torn unsynced tail is fine; an empty disk is not)
+        await quiet_database(c, db)
+
+        # ---- whole-cluster restart ----
+        c.restart_from_disk()
+        await _await_recovered(c, db)
+
+        # ---- second half ----
+        cyc.stop_at = c.loop.now() + half
+        await cyc.start(db)
+        assert cyc.rotations > first_half, "no progress after the restart"
+
+        # ---- quiesce + checks ----
+        c.net.heal()
+        c.net.reboot_dead([p.address for p in c.cluster_procs()])
+        await quiet_database(c, db)
+        await cyc.check(db)
+        await cons.check(db)
+
+    c.run(c.loop.spawn(scenario()), max_time=600_000.0)
+    return cyc
+
+
+def test_restart_from_disk_memory_engine(tmp_path):
+    cyc = _restart_spec(701, "memory", tmp_path)
+    assert cyc.rotations > 0
+
+
+def test_restart_from_disk_ssd_engine(tmp_path):
+    cyc = _restart_spec(702, "ssd", tmp_path)
+    assert cyc.rotations > 0
+
+
+@pytest.mark.slow
+def test_restart_from_disk_double_replication(tmp_path):
+    """Restart with replicated teams: both replicas of every shard recover
+    from disk and the ConsistencyCheck proves they re-converge."""
+    cyc = _restart_spec(703, "memory", tmp_path, n_replicas=2,
+                        n_storage_workers=4, half=15.0)
+    assert cyc.rotations > 0
