@@ -235,22 +235,60 @@ def run_kernel(T: int, n_batches: int, chunk: int,
     }
 
 
+def run_kernel_ab(T: int, n_batches: int = 8,
+                  capacity: int | None = None) -> dict:
+    """A/B the intra-batch evaluator at one batch size: "legacy" (dense
+    overlap matrix + unbounded while_loop fixpoint, the pre-overhaul path)
+    vs "scan" (sorted per-level prefix scans, bounded sweeps). Same
+    pre-staged batches, same state trajectory; reports ms/step for each and
+    the reduction factor. `python bench.py --ab T [n_batches] [capacity]`."""
+    global TXNS_PER_BATCH
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/tmp/fdb_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from foundationdb_tpu.ops.conflict import (
+        ConflictShapes, _compiled_step, init_state)
+    from foundationdb_tpu.utils.jaxenv import ensure_platform_honored
+    from foundationdb_tpu.utils.knobs import KNOBS
+    ensure_platform_honored()
+    TXNS_PER_BATCH = T
+    shapes = ConflictShapes(capacity=capacity or CAPACITY, txns=T,
+                            reads=T, writes=T,
+                            key_bytes=KEY_BYTES, strided=True)
+    batches_np = _encode_batches(n_batches, seed=3, version0=WINDOW)
+    staged = [jax.device_put({k: v[i] for k, v in batches_np.items()})
+              for i in range(n_batches)]
+    out = {"txns_per_batch": T, "batches": n_batches,
+           "backend": jax.default_backend()}
+    for mode in ("scan", "legacy"):
+        step = _compiled_step(shapes,
+                              KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+                              mode, 0)
+        state = init_state(shapes, oldest=0)
+        state, st, _info = step(state, staged[0])  # compile + window fill
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        last = st
+        for b in staged[1:]:
+            state, last, _info = step(state, b)
+        jax.block_until_ready(last)
+        out[mode + "_ms_per_step"] = round(
+            1e3 * (time.perf_counter() - t0) / max(1, n_batches - 1), 2)
+    out["step_time_reduction"] = round(
+        out["legacy_ms_per_step"] / out["scan_ms_per_step"], 2)
+    return out
+
+
 def probe_accelerator(timeout: float = 180.0) -> bool:
     """Can a fresh process attach the accelerator at all? A wedged remote
     runtime hangs the attach indefinitely; probing once in a throwaway
-    subprocess lets every later stage choose CPU up front instead of each
-    burning its own watchdog."""
-    import subprocess
-    import sys
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout,
-            env=dict(os.environ))
-        return proc.returncode == 0 and proc.stdout.strip() not in ("", "cpu")
-    except Exception:  # noqa: BLE001
-        return False
+    subprocess (utils/jaxenv.probe_backend — shared with the resolver's
+    bounded discovery) lets every later stage choose CPU up front instead
+    of each burning its own watchdog."""
+    from foundationdb_tpu.utils.jaxenv import probe_backend
+    ok, _backend = probe_backend(timeout)
+    return ok
 
 
 def run_kernel_watchdogged(T: int, n_batches: int, chunk: int,
@@ -330,5 +368,11 @@ if __name__ == "__main__":
         cap = int(sys.argv[5]) if len(sys.argv) > 5 else None
         print(json.dumps(run_kernel(int(sys.argv[2]), int(sys.argv[3]),
                                     int(sys.argv[4]), capacity=cap)))
+        sys.exit(0)
+    if len(sys.argv) >= 3 and sys.argv[1] == "--ab":
+        nb = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+        cap = int(sys.argv[4]) if len(sys.argv) > 4 else None
+        print(json.dumps(run_kernel_ab(int(sys.argv[2]), n_batches=nb,
+                                       capacity=cap)))
         sys.exit(0)
     main()

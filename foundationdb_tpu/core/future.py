@@ -12,6 +12,7 @@ task-resume callback reschedules the awaiting actor.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Generator
 
 from foundationdb_tpu.utils.errors import FDBError
@@ -119,15 +120,18 @@ class PromiseStream:
     __slots__ = ("_queue", "_waiters", "_closed")
 
     def __init__(self):
-        self._queue: list[Any] = []
-        self._waiters: list[Future] = []
+        # deques: both ends see O(1) — a saturated stream (thousands of
+        # queued commits / GRV waiters) must not turn every pop into a
+        # front-shift of the whole backlog
+        self._queue: deque[Any] = deque()
+        self._waiters: deque[Future] = deque()
         self._closed: BaseException | None = None
 
     def send(self, value: Any = None):
         if self._closed is not None:
             return
         if self._waiters:
-            self._waiters.pop(0)._set(value)
+            self._waiters.popleft()._set(value)
         else:
             self._queue.append(value)
 
@@ -137,13 +141,13 @@ class PromiseStream:
         self._closed = error or FDBError("end_of_stream")
         for w in self._waiters:
             w._set_error(self._closed)
-        self._waiters = []
+        self._waiters = deque()
 
     def pop(self) -> Future:
         """Future of the next value (FIFO among waiters — deterministic)."""
         f = Future()
         if self._queue:
-            f._set(self._queue.pop(0))
+            f._set(self._queue.popleft())
         elif self._closed is not None:
             f._set_error(self._closed)
         else:

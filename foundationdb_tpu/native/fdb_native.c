@@ -714,16 +714,25 @@ static PyObject *py_wire_loads(PyObject *self, PyObject *arg) {
 /* ------------------------------------------------------------------ */
 
 /* encode_conflict_ranges(txns, skip_or_None, rb, re, wb, we, rtxn, wtxn,
- *                        key_bytes) -> (n_reads, n_writes)
+ *                        key_bytes[, snap, valid, base_version])
+ *                        -> (n_reads, n_writes)
  * txns: sequence of objects with .read_ranges/.write_ranges = [(b, e), ...]
  * rb/re/wb/we: writable uint32 buffers (num_limbs x cap, limb-major);
- * rtxn/wtxn: writable int32 buffers (cap). Raises ValueError on overflow. */
+ * rtxn/wtxn: writable int32 buffers (cap). Raises ValueError on overflow.
+ * The optional trailing buffers extend the single pass over the txns to the
+ * whole batch header: snap (int32, one per txn) receives each unskipped
+ * txn's read_snapshot as a clamped offset from base_version, valid (uint8,
+ * one per txn) its inclusion flag — removing the remaining per-txn Python
+ * attribute loop from the dispatch path. */
 static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
     PyObject *txns, *skip;
     Py_buffer rb, re, wb, we, rtxn, wtxn;
+    Py_buffer snap = {0}, valid = {0};
+    long long base_version = 0;
     int key_bytes = KEY_BYTES;
-    if (!PyArg_ParseTuple(args, "OOw*w*w*w*w*w*|i", &txns, &skip, &rb, &re,
-                          &wb, &we, &rtxn, &wtxn, &key_bytes))
+    if (!PyArg_ParseTuple(args, "OOw*w*w*w*w*w*|iw*w*L", &txns, &skip, &rb,
+                          &re, &wb, &we, &rtxn, &wtxn, &key_bytes, &snap,
+                          &valid, &base_version))
         return NULL;
     PyObject *seq = NULL;
     PyObject *ret = NULL;
@@ -747,6 +756,11 @@ static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
     if (!seq)
         goto done;
     Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (snap.buf && ((Py_ssize_t)snap.len < n * 4 ||
+                     (Py_ssize_t)valid.len < n)) {
+        PyErr_SetString(PyExc_ValueError, "snap/valid buffers too small");
+        goto done;
+    }
     for (Py_ssize_t t = 0; t < n; t++) {
         if (skip != Py_None) {
             int truth = PyObject_IsTrue(PySequence_Fast_GET_ITEM(skip, t));
@@ -756,6 +770,22 @@ static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
                 continue;
         }
         PyObject *txn = PySequence_Fast_GET_ITEM(seq, t);
+        if (snap.buf) {
+            PyObject *rs = PyObject_GetAttrString(txn, "read_snapshot");
+            if (!rs)
+                goto done;
+            long long v = PyLong_AsLongLong(rs);
+            Py_DECREF(rs);
+            if (v == -1 && PyErr_Occurred())
+                goto done;
+            long long off = v - base_version;
+            if (off > 2147483647LL)
+                off = 2147483647LL;
+            if (off < -1073741824LL) /* NEG sentinel floor, conflict.py */
+                off = -1073741824LL;
+            ((int32_t *)snap.buf)[t] = (int32_t)off;
+            ((uint8_t *)valid.buf)[t] = 1;
+        }
         for (int pass = 0; pass < 2; pass++) {
             PyObject *ranges = PyObject_GetAttrString(
                 txn, pass == 0 ? "read_ranges" : "write_ranges");
@@ -815,6 +845,10 @@ done:
     PyBuffer_Release(&we);
     PyBuffer_Release(&rtxn);
     PyBuffer_Release(&wtxn);
+    if (snap.buf)
+        PyBuffer_Release(&snap);
+    if (valid.buf)
+        PyBuffer_Release(&valid);
     return ret;
 }
 

@@ -19,10 +19,14 @@ step is a latency-bound multi-limb gather, while the sort runs at bandwidth):
   2. history check: each read endpoint's rank among state boundaries comes
      from the sort; O(1) sparse-table range-max over the segment versions,
      compare against each txn's read snapshot (replaces CheckMax :755-837)
-  3. intra-batch: endpoint ranks from the same sort, pairwise read/write
-     overlap, and an exact lower/upper-bound fixpoint for "earlier txns win"
-     semantics (replaces MiniConflictSet :1028-1130; converges in <=
-     chain-depth iterations, each one int8 MXU mat-vec)
+  3. intra-batch: endpoint ranks from the same sort feed a dyadic
+     sort/scan evaluator for "earlier txns win" semantics — each fixpoint
+     sweep is O(n log n) prefix scans over per-level sorted write endpoints
+     instead of the old dense (NW, NR) overlap matrix mat-vec, and the
+     sweep count is statically bounded (a lax.scan with an early-out cond,
+     never an unbounded while_loop); unconverged batches fall back to an
+     exact host-side pass (replaces MiniConflictSet :1028-1130; see
+     docs/conflict_kernel.md)
   4. merge of surviving writes into the step function: the sorted array IS
      the union; slots, coverage, and values are carved out with prefix scans
      and one compaction scatter (replaces mergeWriteConflictRanges :1260)
@@ -203,9 +207,134 @@ def _carry_last_flagged(values, flags):
     return out
 
 
+def _seg_cummax(vals, reset):
+    """Inclusive running max of `vals` restarting wherever reset=True
+    (segmented cummax; one associative scan — the monoid carries whether a
+    segment boundary was crossed)."""
+    def op(a, b):
+        av, ar = a
+        bv, br = b
+        return jnp.where(br, bv, jnp.maximum(av, bv)), ar | br
+    out, _ = lax.associative_scan(op, (vals, reset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# intra-batch scan evaluator (dyadic decomposition over the txn index)
+# ---------------------------------------------------------------------------
+
+def _intra_scan_levels(T, wtxn_c, rtxn, rbr, rer, wbr, wer):
+    """Sweep-invariant geometry for the scan intra-batch evaluator.
+
+    One level per power-of-two block size 2^l (l < ceil(log2 T)). At level l
+    writes sort by (wtxn >> l, wbr); a read of txn t queries block
+    (t >> l) - 1, i.e. the aligned block of 2^l transactions immediately
+    before t's block. The union of those query blocks over all levels is
+    exactly [0, t) — the canonical dyadic prefix — so "some committed
+    EARLIER txn's write overlaps this read" decomposes into per-level
+    queries whose candidates are contiguous runs of the level-sorted order:
+
+      case A (write begins strictly inside the read): a prefix-sum count
+        between the two query positions;
+      case B (write begins at-or-before the read's begin and covers it): a
+        block-segmented running max of committed write ends, gathered at the
+        first query position.
+
+    Query positions ride the same per-level sort as two query elements per
+    read (class keys order them against equal write begins so <= / < fall
+    out of the element order), so the geometry costs one sort + one
+    inverse-permutation scatter per level PER STEP and is reused by every
+    fixpoint sweep. A read of txn 0 gets query block -1, which sorts before
+    every write and self-masks; padding reads/writes are masked by the
+    validity masks the caller folds into the committed-write vector.
+    """
+    NW = wbr.shape[0]
+    NR = rbr.shape[0]
+    M = NW + 2 * NR
+    n_levels = max(1, int(T - 1).bit_length())
+    arange_m = jnp.arange(M, dtype=jnp.int32)
+    # class tiebreak at equal (block, rank): hi-query(-1) < write(0) <
+    # lo-query(1) => lo counts wbr <= rbr, hi counts wbr < rer
+    cls = jnp.concatenate([
+        jnp.zeros(NW, jnp.int32), jnp.ones(NR, jnp.int32),
+        jnp.full(NR, -1, jnp.int32)])
+    key2 = jnp.concatenate([wbr, rbr, rer])
+    qblk0 = rtxn  # block keys are recomputed per level from the txn index
+    levels = []
+    for l in range(n_levels):
+        key1 = jnp.concatenate(
+            [wtxn_c >> l, (qblk0 >> l) - 1, (qblk0 >> l) - 1])
+        s1, _s2, _scl, si = lax.sort([key1, key2, cls, arange_m], num_keys=3)
+        inv = jnp.zeros(M, jnp.int32).at[si].set(arange_m)
+        is_w = si < NW
+        src = jnp.minimum(si, NW - 1)
+        werl = jnp.where(is_w, wer[src], -1)
+        bnd = jnp.concatenate([jnp.ones(1, bool), s1[1:] != s1[:-1]])
+        levels.append((src, is_w, werl, bnd,
+                       inv[NW:NW + NR], inv[NW + NR:]))
+    return levels
+
+
+def _intra_scan_blocked(c_w, levels, rbr):
+    """blocked_r[j] = some write with c_w=True belonging to an earlier txn
+    overlaps read j. `c_w` is the (NW,) committed∧valid∧nonempty write mask;
+    exactness matches the dense overlap-matrix formulation element for
+    element (same ranks, same strict earlier-txn order)."""
+    NR = rbr.shape[0]
+    blocked = jnp.zeros(NR, bool)
+    for src, is_w, werl, bnd, qlo, qhi in levels:
+        cm = is_w & c_w[src]
+        pref = jnp.cumsum(cm.astype(jnp.int32))  # queries contribute 0
+        count_a = pref[qhi] - pref[qlo]
+        segmax = _seg_cummax(jnp.where(cm, werl, -1), bnd)
+        blocked = blocked | (count_a > 0) | (segmax[qlo] > rbr)
+    return blocked
+
+
+def _run_sandwich(f, g, rounds: int):
+    """Statically-bounded lower/upper sandwich on the antitone map f.
+
+    upper ⊇ truth ⊇ lower is invariant; each round tightens both by one
+    dependency depth from each side, and rounds are skipped via lax.cond
+    once the bounds pinch (so runtime tracks the batch's ACTUAL chain depth,
+    like the old while_loop, but the trip count — hence the jaxpr — is
+    bounded). rounds >= T//2 guarantees convergence for any batch; smaller
+    bounds report converged=False and the host wrapper finishes those txns
+    exactly (DetectHandle.result). Returns (lower, upper, converged)."""
+    upper = g
+    lower = f(upper)
+
+    def round_fn(lu, _):
+        def go(lu):
+            lo, up = lu
+            up2 = f(lo)
+            return f(up2), up2
+        lu2 = lax.cond(jnp.all(lu[0] == lu[1]), lambda x: x, go, lu)
+        return lu2, None
+
+    (lower, upper), _ = lax.scan(round_fn, (lower, upper), None,
+                                 length=max(rounds, 0))
+    return lower, upper, jnp.all(lower == upper)
+
+
+def _auto_rounds(T: int) -> int:
+    """Default sandwich bound: full-convergence for small batches (T//2+1
+    rounds make any chain depth exact), capped at 32 for large ones — a
+    depth-65 dependency chain inside one chunk is adversarial, and those
+    batches still get exact statuses from the host fallback."""
+    return min(T // 2 + 1, 32)
+
+
 def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
-                  max_write_life: int, ablate: str = ""):
+                  max_write_life: int, ablate: str = "",
+                  intra_mode: str = "scan", intra_rounds: int = 0):
     """Pure function: (state, batch) -> (state', statuses, info). Jit-able.
+
+    intra_mode selects the intra-batch fixpoint evaluator: "scan" (default,
+    per-level sorted prefix scans, statically bounded sweeps) or "legacy"
+    (dense overlap matrix + unbounded while_loop — the pre-overhaul path,
+    kept for A/B verification). intra_rounds bounds the scan evaluator's
+    sandwich rounds (0 = auto, see _auto_rounds).
 
     state:
       bkeys (L,K) uint32 sorted; bval (K,) i32; nb () i32; oldest () i32;
@@ -304,17 +433,18 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
         statuses = jnp.where(txn_valid, statuses, COMMITTED)
         return _merge_phase(state, batch, statuses, commit, shapes,
                             max_write_life, ablate, sort_products=(
-                                skeys, scls, sval, sidx, spos, cum_state))
-    # ---- 3. intra-batch: endpoint ranks -> pairwise overlap -> fixpoint ----
-    # The (T,T) dependency matrix of the first design required a 2D scatter
-    # (~170ms/batch on TPU); instead the fixpoint operates directly on the
-    # (NW, NR) range-overlap matrix via an MXU matvec: committed writes ->
-    # blocked reads is one bf16 matmul with exact f32 accumulation (0/1
-    # values), then a cheap 1D segment-max folds reads back to transactions.
+                                skeys, scls, sval, sidx, spos, cum_state),
+                            eligible=g0)
+    # ---- 3. intra-batch: endpoint ranks -> overlap queries -> fixpoint ----
     # Endpoint ranks come from the big sort: rank = number of distinct
     # batch-endpoint key groups at-or-before this element, which is
     # order-isomorphic to the keys over batch endpoints (state elements
-    # interleave but contribute no rank).
+    # interleave but contribute no rank). The default "scan" evaluator
+    # answers each sweep's "does a committed earlier txn's write overlap
+    # this read" with per-level prefix scans over sorted write endpoints
+    # (geometry built once per step, _intra_scan_levels) — O(n log n) per
+    # sweep with no n×n matrix materialized; the "legacy" evaluator is the
+    # pre-overhaul dense (NW, NR) int8 matvec + unbounded while_loop.
     is_batch = ~is_state
     newgrp = jnp.concatenate(
         [jnp.ones(1, bool), ~_key_eq(skeys[:, 1:], skeys[:, :-1])])
@@ -331,55 +461,74 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     # empty/inverted ranges (end <= begin) participate in neither side;
     # strict wtxn < rtxn = "earlier txns win" (checkIntraBatchConflicts
     # SkipList.cpp:1139-1152 processes in batch order)
-    r_nonempty = rbr < rer
-    w_nonempty = wbr < wer
-    if shapes.strided:
-        order_ok = ((jnp.arange(NW, dtype=jnp.int32) // (NW // T))[:, None]
-                    < (jnp.arange(NR, dtype=jnp.int32) // (NR // T))[None, :])
-    else:
-        order_ok = wtxn[:, None] < rtxn[None, :]
-    overlap = ((wbr[:, None] < rer[None, :]) & (rbr[None, :] < wer[:, None])
-               & (wvalid & w_nonempty)[:, None] & (rvalid & r_nonempty)[None, :]
-               & order_ok)  # (NW, NR)
-    # int8 halves the fixpoint's HBM traffic vs bf16 (the matrix read
-    # dominates each matvec); int8 x int8 -> int32 runs natively on the MXU
-    ovf = overlap.astype(jnp.int8)
-    g = txn_valid & ~too_old & ~hist_conflict
+    g = g0
     wtxn_c = jnp.minimum(wtxn, T - 1)
+    r_ok = rvalid & (rbr < rer)
+    w_ok = wvalid & (wbr < wer)
 
-    def _f_commit(c):
-        """f(c)[t] = g[t] and no committed-in-c earlier txn's write overlaps
-        any of t's reads."""
-        cm = jnp.repeat(c, NW // T) if shapes.strided else c[wtxn_c]
-        cw = (cm & wvalid).astype(jnp.int8)
-        blocked_r = lax.dot_general(
-            cw[None, :], ovf, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)[0] > 0
+    def fold_reads(blocked_r):
         if shapes.strided:
-            blocked_t = blocked_r.reshape(T, NR // T).any(axis=1)
+            return blocked_r.reshape(T, NR // T).any(axis=1)
+        return (jnp.zeros(T + 1, bool).at[rtxn].max(blocked_r))[:T]
+
+    if intra_mode == "legacy":
+        if shapes.strided:
+            order_ok = (
+                (jnp.arange(NW, dtype=jnp.int32) // (NW // T))[:, None]
+                < (jnp.arange(NR, dtype=jnp.int32) // (NR // T))[None, :])
         else:
-            blocked_t = (jnp.zeros(T + 1, bool).at[rtxn].max(blocked_r))[:T]
-        return g & ~blocked_t
+            order_ok = wtxn[:, None] < rtxn[None, :]
+        overlap = ((wbr[:, None] < rer[None, :])
+                   & (rbr[None, :] < wer[:, None])
+                   & w_ok[:, None] & r_ok[None, :]
+                   & order_ok)  # (NW, NR)
+        # int8 halves the fixpoint's HBM traffic vs bf16 (the matrix read
+        # dominates each matvec); int8 x int8 -> int32 runs on the MXU
+        ovf = overlap.astype(jnp.int8)
 
-    upper = g
-    lower = _f_commit(upper)
+        def _f_commit(c):
+            """f(c)[t] = g[t] and no committed-in-c earlier txn's write
+            overlaps any of t's reads."""
+            cm = jnp.repeat(c, NW // T) if shapes.strided else c[wtxn_c]
+            cw = (cm & wvalid).astype(jnp.int8)
+            blocked_r = lax.dot_general(
+                cw[None, :], ovf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)[0] > 0
+            return g & ~fold_reads(blocked_r)
 
-    def cond(lu):
-        lower, upper = lu
-        return jnp.any(lower != upper)
+        upper = g
+        lower = _f_commit(upper)
 
-    def body(lu):
-        lower, upper = lu
-        upper2 = _f_commit(lower)
-        lower2 = _f_commit(upper2)
-        return lower2, upper2
+        def cond(lu):
+            lower, upper = lu
+            return jnp.any(lower != upper)
 
-    # typical dependency chains are shallow: unroll the first sandwich round
-    # (each device-loop iteration costs a sync) and fall back to the loop only
-    # for adversarially deep chains
-    lower, upper = body((lower, upper))
-    lower, upper = lax.while_loop(cond, body, (lower, upper))
-    commit = lower
+        def body(lu):
+            lower, upper = lu
+            upper2 = _f_commit(lower)
+            lower2 = _f_commit(upper2)
+            return lower2, upper2
+
+        lower, upper = body((lower, upper))
+        lower, upper = lax.while_loop(cond, body, (lower, upper))
+        commit = lower
+        merge_commit = commit
+        converged = jnp.asarray(True)
+    else:
+        levels = _intra_scan_levels(T, wtxn_c, rtxn, rbr, rer, wbr, wer)
+
+        def _f_commit(c):
+            cw = ((jnp.repeat(c, NW // T) if shapes.strided
+                   else c[wtxn_c]) & w_ok)
+            blocked_r = _intra_scan_blocked(cw, levels, rbr) & r_ok
+            return g & ~fold_reads(blocked_r)
+
+        rounds = intra_rounds if intra_rounds > 0 else _auto_rounds(T)
+        # statuses come from `lower` (⊆ truth: never a false commit) and the
+        # merge uses `upper` (⊇ truth: never a missing write in history);
+        # both are the truth itself whenever converged — always, for
+        # rounds >= T//2+1
+        commit, merge_commit, converged = _run_sandwich(_f_commit, g, rounds)
 
     statuses = jnp.where(
         commit, COMMITTED,
@@ -387,11 +536,14 @@ def conflict_step(state: dict, batch: dict, *, shapes: ConflictShapes,
     statuses = jnp.where(txn_valid, statuses, COMMITTED)
     return _merge_phase(state, batch, statuses, commit, shapes,
                         max_write_life, ablate, sort_products=(
-                            skeys, scls, sval, sidx, spos, cum_state))
+                            skeys, scls, sval, sidx, spos, cum_state),
+                        merge_commit=merge_commit, converged=converged,
+                        eligible=g)
 
 
 def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
-                 ablate="", sort_products=None):
+                 ablate="", sort_products=None, merge_commit=None,
+                 converged=None, eligible=None):
     T, NR, NW, K = shapes.txns, shapes.reads, shapes.writes, shapes.capacity
     L = shapes.limbs
     bkeys, bval, nb, oldest = (
@@ -400,6 +552,12 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
     vnew = batch["commit_version"]
     wvalid = wtxn < T
     wtxn_c = jnp.minimum(wtxn, T - 1)
+    if merge_commit is None:
+        merge_commit = commit
+    if converged is None:
+        converged = jnp.asarray(True)
+    if eligible is None:
+        eligible = commit
 
     if ablate in ("no_merge", "only_hist"):
         new_oldest = jnp.maximum(
@@ -407,7 +565,8 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
                               vnew - jnp.int32(max_write_life), oldest))
         new_state = dict(state, oldest=new_oldest.astype(jnp.int32))
         info = {"overflow": state["poisoned"], "boundaries": nb,
-                "committed": jnp.sum(commit.astype(jnp.int32))}
+                "committed": jnp.sum(commit.astype(jnp.int32)),
+                "converged": converged, "eligible": eligible}
         return new_state, statuses, info
 
     # ---- 4. merge surviving writes into the step function at vnew ----
@@ -424,9 +583,9 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
     N_ALL = K + 2 * NR + 2 * NW
     if shapes.strided:
         wvalid = wb[L - 1] != jnp.uint32(0xFFFFFFFF)
-        commit_w = jnp.repeat(commit, NW // T)
+        commit_w = jnp.repeat(merge_commit, NW // T)
     else:
-        commit_w = commit[wtxn_c]
+        commit_w = merge_commit[wtxn_c]
     # committed, non-empty writes only: an inverted range would inject a
     # reversed -1/+1 coverage delta and cancel other writes' coverage
     cw = wvalid & commit_w & _key_lt(wb, we)
@@ -523,7 +682,8 @@ def _merge_phase(state, batch, statuses, commit, shapes, max_write_life,
         "poisoned": poisoned,
     }
     info = {"overflow": poisoned, "boundaries": n2,
-            "committed": jnp.sum(commit.astype(jnp.int32))}
+            "committed": jnp.sum(commit.astype(jnp.int32)),
+            "converged": converged, "eligible": eligible}
     return new_state, statuses, info
 
 
@@ -561,15 +721,29 @@ def init_state(shapes: ConflictShapes, oldest: int = 0):
 # host wrapper: the ConflictSet a Resolver instantiates
 # ---------------------------------------------------------------------------
 
+def _donate_state_argnums() -> tuple:
+    """Donate the state operand (bkeys + table dominate HBM) on accelerator
+    backends: the update is written in place of the old state instead of
+    alongside it, halving the step's state traffic and footprint. CPU's
+    runtime can't alias these buffers and would warn on every program, so
+    donation is gated to real accelerators."""
+    return (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+
+
 @functools.lru_cache(maxsize=32)
-def _compiled_step(shapes: ConflictShapes, max_write_life: int):
-    """One compiled program per (shapes, window) — shared across instances."""
+def _compiled_step(shapes: ConflictShapes, max_write_life: int,
+                   intra_mode: str = "scan", intra_rounds: int = 0):
+    """One compiled program per (shapes, window, intra config) — shared
+    across instances."""
     return jax.jit(functools.partial(
-        conflict_step, shapes=shapes, max_write_life=max_write_life))
+        conflict_step, shapes=shapes, max_write_life=max_write_life,
+        intra_mode=intra_mode, intra_rounds=intra_rounds),
+        donate_argnums=_donate_state_argnums())
 
 
 def conflict_scan(state: dict, stacked: dict, *, shapes: ConflictShapes,
-                  max_write_life: int):
+                  max_write_life: int, intra_mode: str = "scan",
+                  intra_rounds: int = 0):
     """Run M conflict batches in ONE device dispatch via lax.scan.
 
     `stacked` has the same fields as a conflict_step batch with a leading
@@ -581,7 +755,8 @@ def conflict_scan(state: dict, stacked: dict, *, shapes: ConflictShapes,
     """
     def stepfn(st, batch):
         st2, statuses, info = conflict_step(
-            st, batch, shapes=shapes, max_write_life=max_write_life)
+            st, batch, shapes=shapes, max_write_life=max_write_life,
+            intra_mode=intra_mode, intra_rounds=intra_rounds)
         return st2, (statuses.astype(jnp.int8), info["committed"],
                      info["overflow"])
     final, (stat, comm, ovf) = lax.scan(stepfn, state, stacked)
@@ -589,9 +764,12 @@ def conflict_scan(state: dict, stacked: dict, *, shapes: ConflictShapes,
 
 
 @functools.lru_cache(maxsize=32)
-def _compiled_scan(shapes: ConflictShapes, max_write_life: int):
+def _compiled_scan(shapes: ConflictShapes, max_write_life: int,
+                   intra_mode: str = "scan", intra_rounds: int = 0):
     return jax.jit(functools.partial(
-        conflict_scan, shapes=shapes, max_write_life=max_write_life))
+        conflict_scan, shapes=shapes, max_write_life=max_write_life,
+        intra_mode=intra_mode, intra_rounds=intra_rounds),
+        donate_argnums=_donate_state_argnums())
 
 
 def _resolve_shapes(capacity=None, txns=None, reads_per_txn=None,
@@ -617,6 +795,8 @@ class BatchEncoder:
         self.shapes = shapes
         self.L = shapes.limbs
         self.base_version = base_version
+        self._rings: dict = {}
+        self._last_slot: dict | None = None
         if shapes.strided:
             self._strided_rtxn = jnp.asarray(
                 np.arange(shapes.reads, dtype=np.int32)
@@ -628,6 +808,57 @@ class BatchEncoder:
     def _clamp_off(self, version: int) -> int:
         off = version - self.base_version
         return int(max(min(off, (1 << 31) - 1), _NEG_INT))
+
+    def _buffers(self, sh: ConflictShapes) -> dict:
+        """Reusable encode buffers (a small ring per shape bucket): batch
+        N+1 encodes into a slot whose previous dispatch is provably consumed
+        (its readback marker is_ready), so the encode output lands straight
+        in long-lived host buffers instead of fresh allocations every batch
+        — the host side of the dispatch/readback double-buffering. Slots are
+        created on demand up to CONFLICT_ENCODE_RING; if every slot is still
+        in flight the encode falls back to a fresh allocation (never blocks,
+        never aliases an in-flight transfer)."""
+        T = sh.txns
+        ring = self._rings.setdefault((sh.reads, sh.writes), [])
+        slot = None
+        for s in ring:
+            m = s.get("marker")
+            if m is None or not hasattr(m, "is_ready") or m.is_ready():
+                slot = s
+                break
+        if slot is None and len(ring) < KNOBS.CONFLICT_ENCODE_RING:
+            slot = {}
+            ring.append(slot)
+        if slot is None:
+            slot = {}
+        if "rb" not in slot:
+            slot["rb"] = np.empty((self.L, sh.reads), np.uint32)
+            slot["re"] = np.empty((self.L, sh.reads), np.uint32)
+            slot["wb"] = np.empty((self.L, sh.writes), np.uint32)
+            slot["we"] = np.empty((self.L, sh.writes), np.uint32)
+            slot["snap"] = np.empty(T, np.int32)
+            slot["valid"] = np.empty(T, bool)
+            if not sh.strided:
+                slot["rtxn"] = np.empty(sh.reads, np.int32)
+                slot["wtxn"] = np.empty(sh.writes, np.int32)
+        for f in ("rb", "re", "wb", "we"):
+            slot[f].fill(0xFFFFFFFF)
+        slot["snap"].fill(0)
+        slot["valid"].fill(False)
+        if not sh.strided:
+            slot["rtxn"].fill(T)
+            slot["wtxn"].fill(T)
+        slot["marker"] = None
+        self._last_slot = slot
+        return slot
+
+    def mark_in_flight(self, marker):
+        """Attach the dispatch's readback array to the most recent encode's
+        buffer slot: once it is_ready() the step has consumed its inputs and
+        the slot becomes reusable."""
+        if self._last_slot is not None:
+            self._last_slot["marker"] = marker
+            self._last_slot = None
 
     def bucket_shapes(self, nr: int, nw: int) -> ConflictShapes:
         """Smallest shape bucket covering a chunk with nr reads / nw writes.
@@ -669,8 +900,8 @@ class BatchEncoder:
         wkeys_e: list[bytes] = []
         rt: list[int] = []
         wt: list[int] = []
-        snap = np.zeros(T, np.int32)
-        valid = np.zeros(T, bool)
+        buf = self._buffers(sh)
+        snap, valid = buf["snap"], buf["valid"]
         rpt, wpt = sh.reads // T, sh.writes // T
         for t, txn in enumerate(txns):
             if skip is not None and skip[t]:
@@ -689,14 +920,12 @@ class BatchEncoder:
                 wkeys_e.append(e)
                 wt.append(t * wpt + i if sh.strided else t)
 
-        rb = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
-        re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
-        wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
-        we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
-        # Leaves stay HOST numpy: the jitted step's implicit argument
-        # transfer is asynchronous and batched (sub-ms enqueue), while an
-        # explicit device_put per leaf costs a synchronous handshake each —
-        # on a remote-attached device that is milliseconds per leaf.
+        rb, re, wb, we = buf["rb"], buf["re"], buf["wb"], buf["we"]
+        # Leaves stay HOST numpy (long-lived ring buffers, see _buffers):
+        # the jitted step's implicit argument transfer is asynchronous and
+        # batched (sub-ms enqueue), while an explicit device_put per leaf
+        # costs a synchronous handshake each — on a remote-attached device
+        # that is milliseconds per leaf.
         if sh.strided:
             # ranges land at their txn's stride slots; rtxn/wtxn are implied
             # by position and ignored by the kernel (cached device constants)
@@ -717,8 +946,7 @@ class BatchEncoder:
         _bulk_encode(rkeys_e, re, round_up=True)
         _bulk_encode(wkeys_b, wb, round_up=False)
         _bulk_encode(wkeys_e, we, round_up=True)
-        rtxn = np.full(sh.reads, T, np.int32)
-        wtxn = np.full(sh.writes, T, np.int32)
+        rtxn, wtxn = buf["rtxn"], buf["wtxn"]
         rtxn[: len(rt)] = rt
         wtxn[: len(wt)] = wt
         return {
@@ -738,21 +966,13 @@ class BatchEncoder:
         device engine serves live commit batches)."""
         from foundationdb_tpu import native
         T = sh.txns
-        rb = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
-        re = np.full((self.L, sh.reads), 0xFFFFFFFF, np.uint32)
-        wb = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
-        we = np.full((self.L, sh.writes), 0xFFFFFFFF, np.uint32)
-        rtxn = np.full(sh.reads, T, np.int32)
-        wtxn = np.full(sh.writes, T, np.int32)
+        buf = self._buffers(sh)
+        rb, re, wb, we = buf["rb"], buf["re"], buf["wb"], buf["we"]
+        rtxn, wtxn = buf["rtxn"], buf["wtxn"]
+        snap, valid = buf["snap"], buf["valid"]
         native.mod.encode_conflict_ranges(
-            txns, skip, rb, re, wb, we, rtxn, wtxn, (self.L - 1) * 4)
-        snap = np.zeros(T, np.int32)
-        valid = np.zeros(T, bool)
-        for t, txn in enumerate(txns):
-            if skip is not None and skip[t]:
-                continue
-            valid[t] = True
-            snap[t] = self._clamp_off(txn.read_snapshot)
+            txns, skip, rb, re, wb, we, rtxn, wtxn, (self.L - 1) * 4,
+            snap, valid, self.base_version)
         return {
             "rb": rb, "re": re, "rtxn": rtxn,
             "wb": wb, "we": we, "wtxn": wtxn,
@@ -834,11 +1054,19 @@ def detect_async_impl(engine, txns: list[TxnConflictInfo],
         batch["advance_floor"] = np.bool_(i == len(subs) - 1)
         new_state, statuses, info = step(engine._state, batch)
         engine._state = new_state
-        # statuses + overflow fused into ONE fixed-shape device array
-        # (enqueue-only): every chunk is read back as a single transfer, and
-        # drain_handles can overlap those transfers across batches
-        chunks.append((len(sub), host_too_old,
-                       _combine_status(statuses, info["overflow"])))
+        # statuses + intra-eligibility + overflow + convergence fused into
+        # ONE fixed-shape device array (enqueue-only): every chunk is read
+        # back as a single transfer
+        combined = _combine_status(statuses, info["eligible"],
+                                   info["overflow"], info["converged"])
+        enc.mark_in_flight(combined)
+        # double-buffering: the D2H copy starts NOW, overlapped with the
+        # NEXT chunk's/batch's encode + dispatch, so a later drain (or
+        # result()) finds the bytes already on the host instead of starting
+        # the transfer under a sync
+        if hasattr(combined, "copy_to_host_async"):
+            combined.copy_to_host_async()
+        chunks.append((sub, host_too_old, combined))
     # the kernel's floor advance is replicated host-side exactly
     # (floor = commit_version - window on the last chunk, monotonic max)
     engine.oldest_version = max(
@@ -868,8 +1096,11 @@ class DeviceConflictSet:
         self.encoder = BatchEncoder(self.shapes, base_version=oldest_version)
         self.oldest_version = oldest_version
         self._state = init_state(self.shapes, oldest=0)
+        self._intra = (str(KNOBS.CONFLICT_INTRA_MODE),
+                       int(KNOBS.CONFLICT_INTRA_ROUNDS))
         self._step = _compiled_step(self.shapes,
-                                    KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+                                    KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+                                    *self._intra)
 
     @property
     def base_version(self) -> int:
@@ -899,7 +1130,7 @@ class DeviceConflictSet:
         shapes = (self.encoder.bucket_shapes(nr, nw)
                   if not self.shapes.strided else self.shapes)
         return shapes, _compiled_step(
-            shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+            shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS, *self._intra)
 
     def warmup(self):
         """Compile every serving bucket now (boot-time cost, served-path
@@ -927,14 +1158,17 @@ class DeviceConflictSet:
 
 @functools.cache
 def _combine_fn():
-    # one program per process: statuses is always (shapes.txns,), overflow a
-    # scalar — the fixed output shape keeps the tunnel's compile cache warm
-    return jax.jit(lambda s, o: jnp.concatenate(
-        [s.astype(jnp.int32), jnp.asarray(o, jnp.int32)[None]]))
+    # one program per process: statuses/eligible are always (shapes.txns,),
+    # overflow/converged scalars — the fixed output layout
+    # [statuses | eligible | overflow | converged] keeps the tunnel's
+    # compile cache warm and makes every chunk readback a single transfer
+    return jax.jit(lambda s, g, o, c: jnp.concatenate(
+        [s.astype(jnp.int32), g.astype(jnp.int32),
+         jnp.asarray(o, jnp.int32)[None], jnp.asarray(c, jnp.int32)[None]]))
 
 
-def _combine_status(statuses, overflow):
-    return _combine_fn()(statuses, overflow)
+def _combine_status(statuses, eligible, overflow, converged):
+    return _combine_fn()(statuses, eligible, overflow, converged)
 
 
 def drain_handles(handles: list["DetectHandle"]) -> None:
@@ -955,11 +1189,45 @@ def drain_handles(handles: list["DetectHandle"]) -> None:
         if hasattr(a, "copy_to_host_async"):
             a.copy_to_host_async()
     for h in pend:
-        h._chunks = [(n, too_old, np.asarray(a)) for n, too_old, a in h._chunks]
+        h._chunks = [(sub, too_old, np.asarray(a))
+                     for sub, too_old, a in h._chunks]
+
+
+def _exact_intra_host(sub, host_too_old, eligible):
+    """Exact sequential intra-batch resolution for an unconverged chunk.
+
+    The device's sandwich bound ran out before the chunk's dependency chains
+    pinched (possible only for chains deeper than 2*rounds). Its too-old and
+    history decisions are exact regardless (`eligible` = survived both), so
+    the remaining greedy "earlier txns win" pass runs here against the
+    chunk's original byte ranges — the same loop as the oracle's step 3.
+    The device merged the sandwich UPPER bound into its state (a superset of
+    the writes committed here), which can only create false conflicts for
+    later batches, never false commits."""
+    from foundationdb_tpu.ops.conflict_oracle import _RangeSet
+    statuses = []
+    published = _RangeSet()
+    for t, txn in enumerate(sub):
+        if host_too_old[t]:
+            statuses.append(TOO_OLD)
+            continue
+        if not eligible[t]:
+            statuses.append(CONFLICT)
+            continue
+        if any(published.overlaps(b, e) for b, e in txn.read_ranges):
+            statuses.append(CONFLICT)
+            continue
+        for b, e in txn.write_ranges:
+            published.add(b, e)
+        statuses.append(COMMITTED)
+    return statuses
 
 
 class DetectHandle:
-    """Deferred result of detect_async: statuses fetched on first result()."""
+    """Deferred result of detect_async: statuses fetched on first result().
+
+    Each chunk is (sub_txns, host_too_old, combined) where combined is the
+    device readback [statuses(T) | eligible(T) | overflow | converged]."""
 
     def __init__(self, chunks):
         self._chunks = chunks
@@ -968,18 +1236,25 @@ class DetectHandle:
     def result(self) -> list[int]:
         if self._result is None:
             out: list[int] = []
-            for n, host_too_old, combined in self._chunks:
-                arr = np.asarray(combined)  # statuses ++ [overflow]
-                if arr[-1]:
-                    # The truncated state dropped the highest-key history
-                    # segments and could cause false commits — fatal; the
-                    # owner reconstructs (clearConflictSet semantics,
-                    # SkipList.cpp:957: conflict state is soft).
+            for sub, host_too_old, combined in self._chunks:
+                arr = np.asarray(combined)
+                n = len(sub)
+                tc = (len(arr) - 2) // 2
+                if arr[2 * tc]:
+                    # Overflow: the truncated state dropped the highest-key
+                    # history segments and could cause false commits —
+                    # fatal; the owner reconstructs (clearConflictSet
+                    # semantics, SkipList.cpp:957: conflict state is soft).
                     raise FDBError(
                         "internal_error",
                         "conflict state capacity exceeded; raise CONFLICT_STATE_CAPACITY")
+                if arr[2 * tc + 1]:
+                    statuses = arr[:n]
+                else:
+                    statuses = _exact_intra_host(sub, host_too_old,
+                                                 arr[tc:tc + n])
                 out.extend(TOO_OLD if old else int(s)
-                           for s, old in zip(arr[:n], host_too_old))
+                           for s, old in zip(statuses, host_too_old))
             self._result = out
             self._chunks = None
         return self._result

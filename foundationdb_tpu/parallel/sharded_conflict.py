@@ -42,7 +42,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+try:  # jax >= 0.6 exposes shard_map at top level with check_vma
+    shard_map = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except AttributeError:  # jax 0.4.x: experimental module, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_CHECK_KW = "check_rep"
 
 from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.ops.batch import TOO_OLD, TxnConflictInfo
@@ -101,18 +106,22 @@ _STEP_CACHE: dict = {}
 
 
 def sharded_conflict_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
-                          max_write_life: int):
-    key = (tuple(mesh.devices.flat), shapes, max_write_life)
+                          max_write_life: int, intra_mode: str = "scan",
+                          intra_rounds: int = 0):
+    key = (tuple(mesh.devices.flat), shapes, max_write_life, intra_mode,
+           intra_rounds)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
-    fn = _build_sharded_step(mesh, shapes, max_write_life)
+    fn = _build_sharded_step(mesh, shapes, max_write_life, intra_mode,
+                             intra_rounds)
     _STEP_CACHE[key] = fn
     return fn
 
 
 def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
-                        max_write_life: int):
+                        max_write_life: int, intra_mode: str = "scan",
+                        intra_rounds: int = 0):
     """Build the jitted SPMD step: (stacked_state, batch) -> (state', statuses, info).
 
     stacked_state: state pytree with a leading n_shards axis, sharded over the
@@ -136,7 +145,8 @@ def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
         batch["rb"], batch["re"] = _clip_ranges(batch["rb"], batch["re"], lo, hi)
         batch["wb"], batch["we"] = _clip_ranges(batch["wb"], batch["we"], lo, hi)
         new_state, statuses, info = conflict_step(
-            state, batch, shapes=shapes, max_write_life=max_write_life)
+            state, batch, shapes=shapes, max_write_life=max_write_life,
+            intra_mode=intra_mode, intra_rounds=intra_rounds)
         new_state["lo"] = lo
         new_state["hi"] = hi
         # proxy combine: min over shards (MasterProxyServer.actor.cpp:492-504)
@@ -146,6 +156,16 @@ def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
             "boundaries": lax.pmax(info["boundaries"], RESOLVER_AXIS),
             # mask padding slots (forced COMMITTED inside conflict_step)
             "committed": jnp.sum((statuses == 2) & batch["txn_valid"]),
+            # the sharded engine always runs full sandwich rounds (see
+            # ShardedDeviceConflictSet: the host fallback can't reproduce
+            # per-shard intra semantics), so this stays True; combined
+            # defensively anyway
+            "converged": lax.pmin(
+                info["converged"].astype(jnp.int32), RESOLVER_AXIS) > 0,
+            # eligible on every shard — only consulted by the (never-taken)
+            # fallback path
+            "eligible": lax.pmin(
+                info["eligible"].astype(jnp.int32), RESOLVER_AXIS) > 0,
         }
         return jax.tree.map(lambda x: x[None], new_state), statuses, info
 
@@ -164,13 +184,16 @@ def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
         local_step, mesh=mesh,
         in_specs=(state_specs, batch_specs),
         out_specs=(state_specs, P(), {"overflow": P(), "boundaries": P(),
-                                      "committed": P()}),
-        # conflict_step's fori_loop carries start from unvarying constants and
-        # become shard-varying inside the loop; the static VMA check can't
-        # type that, so it is disabled (collectives used are only pmin/pmax).
-        check_vma=False,
+                                      "committed": P(), "converged": P(),
+                                      "eligible": P()}),
+        # conflict_step's bounded-scan carries start from unvarying constants
+        # and become shard-varying inside the loop; the static replication /
+        # VMA check can't type that, so it is disabled (collectives are only
+        # pmin/pmax).
+        **{_SHARD_MAP_CHECK_KW: False},
     )
-    return jax.jit(sharded)
+    from foundationdb_tpu.ops.conflict import _donate_state_argnums
+    return jax.jit(sharded, donate_argnums=_donate_state_argnums())
 
 
 def init_sharded_state(shapes: ConflictShapes, n_shards: int, oldest: int = 0,
@@ -213,8 +236,16 @@ class ShardedDeviceConflictSet:
         assert self.cut_bytes[0] == b"" and len(self.cut_bytes) == self.n_shards
         self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0,
                                          cut_bytes=self.cut_bytes)
+        # full sandwich rounds (T//2+1): the host-exact fallback resolves
+        # intra conflicts with SINGLE-resolver semantics, which per-shard
+        # "earlier txns win" + pmin does not reduce to, so the sharded
+        # engine must always converge on device. The early-out cond makes
+        # the unused rounds ~free once the bounds pinch.
+        intra_rounds = (self.shapes.txns // 2 + 1
+                        if str(KNOBS.CONFLICT_INTRA_MODE) == "scan" else 0)
         self._step = sharded_conflict_step(
-            self.mesh, self.shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+            self.mesh, self.shapes, KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+            str(KNOBS.CONFLICT_INTRA_MODE), intra_rounds)
         # resolutionBalancing inputs (masterserver.actor.cpp:955-1012 via
         # Resolver iops sampling :146-151): per-shard range counts + a
         # bounded reservoir of range-begin prefixes

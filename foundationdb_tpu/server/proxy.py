@@ -24,6 +24,7 @@ being ordered, not from asking the master.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 
 from foundationdb_tpu.core import sim_validation
@@ -216,7 +217,10 @@ class Proxy:
         self.n_proxies = n_proxies
         self._rk_tps: float | None = None
         self._grv_tokens = 1.0
-        self._grv_queue: list = []
+        # deque: under throttle the line grows to thousands of waiters and
+        # the pump pops from the front every tick — list.pop(0) would make
+        # each handout O(queue)
+        self._grv_queue: deque = deque()
         self._rk_tasks = []
         if ratekeeper is not None:
             self._rk_tasks = [
@@ -238,7 +242,7 @@ class Proxy:
         for t in self._rk_tasks:
             t.cancel()
         self._master_last_seen = float("-inf")  # fence immediately
-        queued, self._grv_queue = self._grv_queue, []
+        queued, self._grv_queue = self._grv_queue, deque()
         for reply in queued:  # don't strand throttled waiters until timeout
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy shut down"))
@@ -380,7 +384,7 @@ class Proxy:
                                        + self._rk_tps * interval, burst)
             while self._grv_queue and self._grv_tokens >= 1.0:
                 self._grv_tokens -= 1.0
-                reply = self._grv_queue.pop(0)
+                reply = self._grv_queue.popleft()
                 # the lease can expire while a request waits in line; serving
                 # it anyway would hand out a deposed generation's stale
                 # committed version past the recovery grace period

@@ -31,13 +31,37 @@ def new_conflict_set(oldest_version: int = 0):
                 (parallel/sharded_conflict.py), with resolutionBalancing
                 (load-sampled cut moves) built in
     "oracle"  — pure-Python CPU reference
+
+    Device backends attach the accelerator lazily on their first jax call —
+    which, on a wedged remote runtime, hangs with no deadline. Bound the
+    discovery FIRST: if the probe can't attach within its deadline the
+    process is pinned to CPU and the engine is constructed (and labeled)
+    as a cpu-fallback instead of hanging warmup()/recovery.
     """
+    if KNOBS.CONFLICT_BACKEND in ("device", "sharded"):
+        from foundationdb_tpu.utils.jaxenv import bound_device_discovery
+        backend_label = bound_device_discovery()
+        if (backend_label in ("cpu", "cpu-fallback", "initialized")
+                and KNOBS.CONFLICT_CPU_FALLBACK == "host"):
+            # No accelerator attached: the XLA-on-CPU step costs ~10-20x the
+            # host skiplist per txn (one core runs BOTH the engine and the
+            # whole pipeline), so degrade the *evaluator* to the exact host
+            # path while keeping the backend knob's serving contract.
+            # Decisions are identical by construction (the oracle is the
+            # semantic authority the device kernel is fuzzed against).
+            cs = OracleConflictSet(oldest_version=oldest_version)
+            cs.backend_label = f"{backend_label}+host-evaluator"
+            return cs
     if KNOBS.CONFLICT_BACKEND == "device":
-        return DeviceConflictSet(oldest_version=oldest_version)
+        cs = DeviceConflictSet(oldest_version=oldest_version)
+        cs.backend_label = backend_label
+        return cs
     if KNOBS.CONFLICT_BACKEND == "sharded":
         from foundationdb_tpu.parallel.sharded_conflict import (
             ShardedDeviceConflictSet)
-        return ShardedDeviceConflictSet(oldest_version=oldest_version)
+        cs = ShardedDeviceConflictSet(oldest_version=oldest_version)
+        cs.backend_label = backend_label
+        return cs
     return OracleConflictSet(oldest_version=oldest_version)
 
 

@@ -1,17 +1,44 @@
-"""Honor JAX_PLATFORMS even when a PJRT plugin overrides it.
+"""Bounded JAX backend discovery + honoring JAX_PLATFORMS.
 
-The accelerator plugin registered at interpreter start may set
-jax_platforms programmatically, which SILENTLY overrides the JAX_PLATFORMS
-environment variable — a process launched with JAX_PLATFORMS=cpu can still
-try to attach the remote accelerator (and hang on it if the runtime is
-wedged). Every entry point that constructs a device engine calls
-ensure_platform_honored() first, re-asserting the operator's choice into
-the config before any backend initialization.
+Two failure modes of a remote accelerator runtime motivate this module:
+
+1. The PJRT plugin registered at interpreter start may set jax_platforms
+   programmatically, which SILENTLY overrides the JAX_PLATFORMS environment
+   variable — a process launched with JAX_PLATFORMS=cpu can still try to
+   attach the remote accelerator (and hang on it if the runtime is wedged).
+   Every entry point that constructs a device engine calls
+   ensure_platform_honored() first, re-asserting the operator's choice into
+   the config before any backend initialization.
+
+2. When JAX_PLATFORMS is NOT set, the first jax.devices() call attaches the
+   accelerator with NO deadline: a wedged runtime hangs resolver warmup()
+   (and with it recovery) and bench.py forever. probe_backend() answers "can
+   a fresh process attach at all?" in a throwaway SUBPROCESS with a hard
+   timeout, and bound_device_discovery() pins the current process to CPU
+   (the labeled `cpu-fallback` degradation) when the answer is no — the
+   serving path keeps deciding batches on CPU instead of hanging.
 """
 
 from __future__ import annotations
 
 import os
+
+# cache key: the JAX_PLATFORMS value the probe ran under. One probe per
+# process per platform choice; a wedged runtime costs the timeout once,
+# not once per engine construction.
+_probe_cache: dict[str, tuple[bool, str]] = {}
+
+PROBE_TIMEOUT_ENV = "FDB_TPU_PROBE_TIMEOUT"
+_DEFAULT_PROBE_TIMEOUT = 180.0
+
+
+def _probe_timeout(timeout: float | None) -> float:
+    if timeout is not None:
+        return timeout
+    try:
+        return float(os.environ.get(PROBE_TIMEOUT_ENV, ""))
+    except ValueError:
+        return _DEFAULT_PROBE_TIMEOUT
 
 
 def ensure_platform_honored() -> None:
@@ -23,3 +50,62 @@ def ensure_platform_honored() -> None:
         jax.config.update("jax_platforms", plat)
     except Exception:  # noqa: BLE001 — backend already initialized: too late
         pass
+
+
+def probe_backend(timeout: float | None = None,
+                  refresh: bool = False) -> tuple[bool, str]:
+    """(accelerator_ok, backend_name) with a hard deadline.
+
+    Runs `jax.default_backend()` in a throwaway subprocess so a wedged
+    accelerator attach can neither hang nor poison THIS process's jax
+    runtime. Cached per JAX_PLATFORMS value; `refresh=True` re-probes.
+    """
+    key = os.environ.get("JAX_PLATFORMS", "")
+    if key.strip().lower() == "cpu":
+        return (False, "cpu")  # operator pinned CPU: nothing to discover
+    if not refresh and key in _probe_cache:
+        return _probe_cache[key]
+    import subprocess
+    import sys
+    ok, backend = False, "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=_probe_timeout(timeout),
+            env=dict(os.environ))
+        if proc.returncode == 0 and proc.stdout.strip():
+            backend = proc.stdout.strip().splitlines()[-1]
+            ok = backend not in ("", "cpu")
+    except Exception:  # noqa: BLE001 — timeout/spawn failure == unavailable
+        ok, backend = False, "cpu"
+    _probe_cache[key] = (ok, backend)
+    return ok, backend
+
+
+def bound_device_discovery(timeout: float | None = None) -> str:
+    """Device discovery with a deadline, for serving paths.
+
+    Call BEFORE the first backend-initializing jax call (jax.devices(),
+    jit dispatch, ...). Returns the backend label the process will use:
+    the accelerator name when the bounded probe attaches one, else
+    "cpu-fallback" — in which case JAX_PLATFORMS=cpu is pinned into the
+    environment AND jax.config so the subsequent attach cannot hang.
+
+    When the operator already chose a platform via JAX_PLATFORMS, that
+    choice is honored verbatim (no probe, no override).
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        ensure_platform_honored()
+        return plat.strip().lower()
+    ok, backend = probe_backend(timeout)
+    if ok:
+        return backend
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up (and alive): keep it
+        return "initialized"
+    return "cpu-fallback"

@@ -102,6 +102,28 @@ KNOBS.init("CONFLICT_STATE_CAPACITY", 1 << 16, (1 << 10,))  # boundary slots
 KNOBS.init("CONFLICT_BATCH_TXNS", 1024)  # static batch shape: txns
 KNOBS.init("CONFLICT_BATCH_READS_PER_TXN", 4)
 KNOBS.init("CONFLICT_BATCH_WRITES_PER_TXN", 4)
+# Intra-batch "earlier txns win" evaluator: "scan" = sorted per-level
+# prefix scans (O(n log n) per sweep, bounded sweep count, no while_loop in
+# the jaxpr); "legacy" = dense (NW, NR) overlap matrix + unbounded
+# while_loop fixpoint (kept for the CI A/B smoke test and as an escape
+# hatch). See docs/conflict_kernel.md.
+KNOBS.init("CONFLICT_INTRA_MODE", "scan", ("legacy",))
+# Sandwich sweep rounds for the scan evaluator; 0 = auto
+# (min(txns // 2 + 1, 32) — guaranteed-exact for txns <= 64, bounded with a
+# host-exact fallback beyond that; see conflict.py _run_sandwich).
+KNOBS.init("CONFLICT_INTRA_ROUNDS", 0, (1,))
+# Reusable host-side encode buffer ring (double-buffering the dispatch path:
+# batch N+1 encodes into a different slot than the one batch N's transfer may
+# still be reading). 0 disables pooling.
+KNOBS.init("CONFLICT_ENCODE_RING", 4, (0,))
+# What the device/sharded backend serves with when bound_device_discovery()
+# finds NO accelerator (probe timeout / JAX_PLATFORMS=cpu): "host" = the
+# exact host evaluator (ops/conflict_oracle.py, the semantic authority —
+# XLA-on-CPU pays ~10-20x the per-txn cost of the host skiplist, so running
+# the device kernel there loses end-to-end; see docs/conflict_kernel.md);
+# "jax" = run the JAX kernel on the XLA CPU backend anyway (kernel CI,
+# parity fuzz, measurement runs).
+KNOBS.init("CONFLICT_CPU_FALLBACK", "host", ("jax",))
 
 # --- Client (fdbclient/Knobs.cpp) ---
 KNOBS.init("MAX_BATCH_SIZE", 20, (1,))  # read-version batcher

@@ -179,6 +179,7 @@ def test_region_failover_with_device_backend():
     re-instantiate the engine (fresh conflict state) in the surviving
     region with zero acked loss."""
     KNOBS.set("CONFLICT_BACKEND", "device")
+    KNOBS.set("CONFLICT_CPU_FALLBACK", "jax")  # exercise the JAX serving path in CI
     KNOBS.set("CONFLICT_BATCH_TXNS", 16)
     KNOBS.set("CONFLICT_BATCH_READS_PER_TXN", 2)
     KNOBS.set("CONFLICT_BATCH_WRITES_PER_TXN", 2)
