@@ -3,24 +3,37 @@
 The deterministic simulator (core/sim.py, after fdbrpc/sim2.actor.cpp) only
 delivers its replay guarantee if no actor code smuggles in wall-clock time,
 OS randomness, or settle-skipping control flow. This engine walks Python
-sources, runs a registry of rules (rules.py, FLOW001..FLOW006) over each
-module's AST, and diffs the findings against a checked-in baseline of
-documented grandfathered violations — so every new violation fails tier-1
-(tests/test_flowlint.py) the moment it is written.
+sources, runs a registry of rules over each module's AST — plus a
+package-level pass for interprocedural rules — and diffs the findings
+against a checked-in baseline of documented grandfathered violations, so
+every new violation fails tier-1 the moment it is written.
+
+Two rule families ride the engine:
+  - flow (rules.py, FLOW001..FLOW006): actor discipline & determinism,
+    enforced by tests/test_flowlint.py.
+  - dev (devlint.py, DEV001..DEV008): JAX/device discipline on the hot
+    path (readbacks, re-traces, transfer choke points), enforced by
+    tests/test_devlint.py.
 
 Engine pieces:
   - Finding: one violation, with a line-number-independent identity key
     (rule, path, enclosing symbol, detail) so baselines survive edits.
   - ModuleContext: parsed module + parent links + qualname/suppression
     helpers shared by all rules.
-  - Rule: base class; rules self-register via @register.
+  - PackageContext (callgraph.py): whole-target-set parse + call-site
+    resolution, for rules whose evidence crosses module boundaries.
+  - Rule: base class; rules self-register via @register and may implement
+    check() (per module), check_package() (whole package), or both.
   - analyze_source / analyze_paths: run the registry over snippets or trees.
   - baseline load/apply/write: the allowlist workflow
-    (`python -m foundationdb_tpu.analysis --update-baseline`).
+    (`python -m foundationdb_tpu.analysis --update-baseline`), with a
+    fuzzy second matching tier so renaming an enclosing function does not
+    orphan its documented entries.
 
 Inline suppression: a line containing `# flowlint: ignore[FLOW00X]` (or
-`ignore[all]`) is exempt — for the rare spot where the rule's static
-approximation is provably wrong and a baseline entry would be noise.
+`# devlint: ignore[DEV00X]`, `ignore[all]`, or a comma-separated code
+list) is exempt — for the rare spot where the rule's static approximation
+is provably wrong and a baseline entry would be noise.
 """
 
 from __future__ import annotations
@@ -28,14 +41,23 @@ from __future__ import annotations
 import ast
 import json
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
 PACKAGE_NAME = "foundationdb_tpu"
 
 # Subpackages whose coroutines are sim-visible: they run under the
-# deterministic loop and must draw time/randomness from it.
-SIM_VISIBLE = ("core", "server", "net")
+# deterministic loop and must draw time/randomness from it. testing/ hosts
+# the simulated-cluster workloads — sim-visible code in every sense.
+SIM_VISIBLE = ("core", "server", "net", "testing")
+
+FAMILIES = ("flow", "dev")
+
+
+def rule_family(code: str) -> str:
+    """Family of a rule code: DEV* -> "dev", everything else -> "flow"."""
+    return "dev" if code.startswith("DEV") else "flow"
 
 
 @dataclass(frozen=True)
@@ -110,13 +132,24 @@ class ModuleContext:
         return ".".join(reversed(names)) or "<module>"
 
     def suppressed(self, line: int, rule: str) -> bool:
+        """`# flowlint: ignore[FLOW001]` / `# devlint: ignore[DEV007]` /
+        `ignore[FLOW001,FLOW002]` / `ignore[all]`. Either tag word accepts
+        either family's codes — the split exists for greppability, not
+        scoping."""
         if not 1 <= line <= len(self.lines):
             return False
         text = self.lines[line - 1]
-        if "flowlint:" not in text:
-            return False
-        tag = text.split("flowlint:", 1)[1]
-        return f"ignore[{rule}]" in tag or "ignore[all]" in tag
+        for marker in ("flowlint:", "devlint:"):
+            if marker not in text:
+                continue
+            tag = text.split(marker, 1)[1]
+            m = re.search(r"ignore\[([^\]]*)\]", tag)
+            if m is None:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",")}
+            if "all" in codes or rule in codes:
+                return True
+        return False
 
     # -- import resolution (aliases -> dotted module names) --
 
@@ -165,13 +198,23 @@ class ModuleContext:
 
 
 class Rule:
-    """One check. Subclasses set `code`/`summary` and implement check()."""
+    """One check. Subclasses set `code`/`summary` and implement check()
+    (per-module) and/or check_package() (whole-target-set, for rules whose
+    evidence crosses module boundaries)."""
 
     code = "FLOW000"
     summary = ""
 
-    def check(self, mod: ModuleContext) -> Iterable[Finding]:  # pragma: no cover
-        raise NotImplementedError
+    @property
+    def family(self) -> str:
+        return rule_family(self.code)
+
+    def check(self, mod: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def check_package(self, pkg) -> Iterable[Finding]:
+        """pkg is a callgraph.PackageContext over every analyzed module."""
+        return ()
 
     def finding(self, mod: ModuleContext, node: ast.AST, detail: str,
                 message: str) -> Finding:
@@ -189,35 +232,56 @@ def register(cls: type[Rule]) -> type[Rule]:
     return cls
 
 
-def active_rules() -> list[Rule]:
-    # rules.py populates the registry on import
-    from foundationdb_tpu.analysis import rules  # noqa: F401
-    return [cls() for cls in sorted(_REGISTRY, key=lambda c: c.code)]
+def active_rules(family: str = "all") -> list[Rule]:
+    # importing the rule modules populates the registry
+    from foundationdb_tpu.analysis import devlint, rules  # noqa: F401
+    out = [cls() for cls in sorted(_REGISTRY, key=lambda c: c.code)]
+    if family != "all":
+        out = [r for r in out if r.family == family]
+    return out
 
 
 # ---------------------------------------------------------------- running
 
-def analyze_source(source: str, relpath: str,
-                   rules: list[Rule] | None = None) -> list[Finding]:
-    """Run the registry over one module's source (tests feed snippets here;
-    `relpath` decides path-scoped rules like FLOW001)."""
-    tree = ast.parse(source)
-    mod = ModuleContext(relpath, source, tree)
+def _run_rules(mods: list[ModuleContext],
+               rules: list[Rule]) -> list[Finding]:
+    """Per-module checks + one package pass, suppression-filtered."""
+    from foundationdb_tpu.analysis.callgraph import PackageContext
+    pkg = PackageContext(mods)
+    by_path = {m.relpath: m for m in mods}
     out: list[Finding] = []
-    for rule in (rules if rules is not None else active_rules()):
-        for f in rule.check(mod):
-            if not mod.suppressed(f.line, f.rule):
+    for rule in rules:
+        found: list[Finding] = []
+        for mod in mods:
+            found.extend(rule.check(mod))
+        found.extend(rule.check_package(pkg))
+        for f in found:
+            owner = by_path.get(f.path)
+            if owner is None or not owner.suppressed(f.line, f.rule):
                 out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
 
+def analyze_source(source: str, relpath: str,
+                   rules: list[Rule] | None = None) -> list[Finding]:
+    """Run the registry over one module's source (tests feed snippets here;
+    `relpath` decides path-scoped rules like FLOW001). Package rules see a
+    one-module package."""
+    tree = ast.parse(source)
+    mod = ModuleContext(relpath, source, tree)
+    return _run_rules([mod], rules if rules is not None else active_rules())
+
+
 def canonical_relpath(abspath: str) -> str:
     """Package-rooted path for baseline stability: the same file keys
-    identically no matter what directory the analyzer was launched from."""
+    identically no matter what directory the analyzer was launched from.
+    Repo-level `scripts/` files anchor at the scripts dir the same way."""
     parts = os.path.abspath(abspath).replace(os.sep, "/").split("/")
     if PACKAGE_NAME in parts:
         return "/".join(parts[parts.index(PACKAGE_NAME):])
+    if "scripts" in parts:
+        return "/".join(parts[parts.index("scripts"):])
     return os.path.relpath(abspath).replace(os.sep, "/")
 
 
@@ -235,21 +299,30 @@ def iter_py_files(path: str) -> Iterator[str]:
 
 def analyze_paths(paths: list[str],
                   rules: list[Rule] | None = None) -> list[Finding]:
+    """Parse every target file first, then run the registry over the whole
+    set as ONE package — interprocedural rules see cross-module calls."""
     rules = rules if rules is not None else active_rules()
+    mods: list[ModuleContext] = []
     out: list[Finding] = []
+    seen: set[str] = set()
     for path in paths:
         for file in iter_py_files(path):
+            relpath = canonical_relpath(file)
+            if relpath in seen:
+                continue
+            seen.add(relpath)
             with open(file, encoding="utf-8") as f:
                 source = f.read()
             try:
-                out.extend(analyze_source(source, canonical_relpath(file),
-                                          rules))
+                mods.append(ModuleContext(relpath, source,
+                                          ast.parse(source)))
             except SyntaxError as e:
                 out.append(Finding(
-                    rule="FLOW000", path=canonical_relpath(file),
+                    rule="FLOW000", path=relpath,
                     line=e.lineno or 0, symbol="<module>",
                     detail="syntax-error",
                     message=f"could not parse: {e.msg}"))
+    out.extend(_run_rules(mods, rules))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
@@ -283,31 +356,83 @@ def load_baseline(path: str | None) -> Baseline:
     return Baseline(path=path, entries=list(data.get("entries", [])))
 
 
-def apply_baseline(findings: list[Finding],
-                   baseline: Baseline) -> tuple[list[Finding], list[dict]]:
-    """-> (new findings not in the baseline, stale entries matching nothing)."""
-    keys = baseline.keys
-    new = [f for f in findings if f.key not in keys]
+def _fuzzy_key(rule: str, path: str, detail: str) -> str:
+    return f"{rule}:{path}:{detail}"
+
+
+def apply_baseline(findings: list[Finding], baseline: Baseline,
+                   families: set[str] | None = None,
+                   ) -> tuple[list[Finding], list[dict]]:
+    """-> (new findings not in the baseline, stale entries matching nothing).
+
+    Matching is two-tier: exact identity key first, then (rule, path,
+    detail) — so renaming the enclosing function (or moving the line) does
+    not orphan a documented entry. The fuzzy tier is count-aware: two
+    findings cannot both consume one entry.
+
+    `families` restricts which baseline entries participate: a
+    `--family flow` run must neither report the dev entries stale nor vice
+    versa.
+    """
+    entries = [e for e in baseline.entries
+               if families is None or rule_family(e["rule"]) in families]
+    exact = {_entry_key(e) for e in entries}
     live = {f.key for f in findings}
-    stale = [e for e in baseline.entries if _entry_key(e) not in live]
+    matched: set[int] = set()  # indexes of entries consumed (exact or fuzzy)
+    for i, e in enumerate(entries):
+        if _entry_key(e) in live:
+            matched.add(i)
+    # fuzzy tier: unmatched findings vs unmatched entries by (rule, path,
+    # detail), greedy one-to-one
+    fuzzy_pool: dict[str, list[int]] = {}
+    for i, e in enumerate(entries):
+        if i not in matched:
+            fuzzy_pool.setdefault(
+                _fuzzy_key(e["rule"], e["path"], e["detail"]), []).append(i)
+    new: list[Finding] = []
+    for f in findings:
+        if f.key in exact:
+            continue
+        pool = fuzzy_pool.get(_fuzzy_key(f.rule, f.path, f.detail))
+        if pool:
+            matched.add(pool.pop(0))
+            continue
+        new.append(f)
+    stale = [e for i, e in enumerate(entries) if i not in matched]
     return new, stale
 
 
-def write_baseline(path: str, findings: list[Finding],
-                   old: Baseline) -> Baseline:
+def write_baseline(path: str, findings: list[Finding], old: Baseline,
+                   families: set[str] | None = None) -> Baseline:
     """Regenerate the baseline from current findings, carrying forward the
-    documented reasons of entries that still match."""
+    documented reasons of entries that still match (exactly, or fuzzily by
+    (rule, path, detail) after a rename). Entries of families NOT in this
+    run are preserved verbatim — a flow-only update cannot drop dev
+    grandfathers."""
     reasons = {_entry_key(e): e.get("reason", "") for e in old.entries}
+    fuzzy_reasons = {
+        _fuzzy_key(e["rule"], e["path"], e["detail"]): e.get("reason", "")
+        for e in old.entries}
     entries, seen = [], set()
+    for e in old.entries:
+        if families is not None and rule_family(e["rule"]) not in families:
+            entries.append(dict(e))
+            seen.add(_entry_key(e))
     for f in findings:
+        if families is not None and rule_family(f.rule) not in families:
+            continue
         if f.key in seen:
             continue
         seen.add(f.key)
         entries.append({
             "rule": f.rule, "path": f.path, "symbol": f.symbol,
             "detail": f.detail,
-            "reason": reasons.get(f.key) or "FIXME: document why this is safe",
+            "reason": reasons.get(f.key)
+            or fuzzy_reasons.get(_fuzzy_key(f.rule, f.path, f.detail))
+            or "FIXME: document why this is safe",
         })
+    entries.sort(key=lambda e: (e["rule"], e["path"], e["symbol"],
+                                e["detail"]))
     data = {"version": 1, "entries": entries}
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=True)
@@ -327,6 +452,17 @@ def format_json(findings: list[Finding]) -> str:
                       indent=2, sort_keys=True)
 
 
+def format_github(findings: list[Finding]) -> str:
+    """GitHub Actions workflow annotations: one ::error line per finding,
+    rendered inline on the PR diff by the runner."""
+    out = []
+    for f in findings:
+        msg = f.message.replace("%", "%25").replace("\n", "%0A")
+        out.append(f"::error file={f.path},line={f.line},"
+                   f"title={f.rule} [{f.symbol}]::{msg}")
+    return "\n".join(out)
+
+
 def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(__file__), "flowlint_baseline.json")
 
@@ -334,3 +470,12 @@ def default_baseline_path() -> str:
 def default_target() -> str:
     """The package directory itself (analyze everything)."""
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_targets() -> list[str]:
+    """Package dir + the repo-level scripts/ dir when it exists: profiling
+    and A/B harness scripts drive the same device code paths the package
+    rules protect."""
+    pkg = default_target()
+    scripts = os.path.join(os.path.dirname(pkg), "scripts")
+    return [pkg] + ([scripts] if os.path.isdir(scripts) else [])

@@ -741,6 +741,16 @@ def _compiled_step(shapes: ConflictShapes, max_write_life: int,
         donate_argnums=_donate_state_argnums())
 
 
+@functools.lru_cache(maxsize=1)
+def _compiled_rebase():
+    """Compiled rebase_state with the state operand donated: the rebase
+    overwrites the engine's only reference to the old state, so eager
+    op-by-op dispatch (jnp.maximum + _build_table per call, old buffers
+    alive until the host reassignment lands) doubled state traffic for
+    nothing. One program per process — delta is a traced scalar."""
+    return jax.jit(rebase_state, donate_argnums=_donate_state_argnums())
+
+
 def conflict_scan(state: dict, stacked: dict, *, shapes: ConflictShapes,
                   max_write_life: int, intra_mode: str = "scan",
                   intra_rounds: int = 0):
@@ -1112,7 +1122,7 @@ class DeviceConflictSet:
         while commit_version - self.encoder.base_version > _REBASE_THRESHOLD:
             delta = min(commit_version - self.encoder.base_version - (1 << 24),
                         1 << 30)
-            self._state = rebase_state(self._state, delta)
+            self._state = _compiled_rebase()(self._state, np.int32(delta))
             self.encoder.base_version += delta
 
     # -- ConflictBatch interface --
@@ -1191,6 +1201,28 @@ def drain_handles(handles: list["DetectHandle"]) -> None:
     for h in pend:
         h._chunks = [(sub, too_old, np.asarray(a))
                      for sub, too_old, a in h._chunks]
+
+
+def drain_and_collect(
+        handles: list["DetectHandle"],
+) -> list[tuple[list[int] | None, "FDBError | None"]]:
+    """drain_handles + result() for every handle, entirely off-loop.
+
+    One (statuses, error) pair per handle, in order. This exists so a
+    coroutine can offload the WHOLE materialization in a single
+    loop.run_blocking(...) call: result() can fall back to the exact host
+    intra-batch pass (_exact_intra_host) on an unconverged chunk, which is
+    milliseconds of host compute the event-loop thread should never eat.
+    Errors are returned, not raised — a capacity overflow on one handle
+    must not strand the remaining handles' results."""
+    drain_handles(handles)
+    out: list[tuple[list[int] | None, FDBError | None]] = []
+    for h in handles:
+        try:
+            out.append((h.result(), None))
+        except FDBError as e:
+            out.append((None, e))
+    return out
 
 
 def _exact_intra_host(sub, host_too_old, eligible):

@@ -102,6 +102,27 @@ def _clip_ranges(b, e, lo, hi):
     return b2, e2
 
 
+@functools.lru_cache(maxsize=1)
+def _compiled_vmapped_rebase():
+    """Per-shard rebase, compiled once per process with the stacked state
+    donated (delta is a traced scalar). The previous inline
+    `jax.vmap(...)(core)` built a fresh traced callable on every rebase —
+    a full re-trace per call, on top of keeping the dead pre-rebase state
+    alive (devlint DEV002/DEV006)."""
+    from foundationdb_tpu.ops.conflict import _donate_state_argnums
+    return jax.jit(jax.vmap(rebase_state, in_axes=(0, None)),
+                   donate_argnums=_donate_state_argnums())
+
+
+@functools.lru_cache(maxsize=1)
+def _compiled_table_builder():
+    """Vmapped _build_table, compiled once per process. rebalance_cuts
+    previously did `jax.jit(jax.vmap(_build_table))(...)` inline — a
+    re-trace AND re-compile on every partition move (devlint DEV002)."""
+    from foundationdb_tpu.ops.conflict import _build_table
+    return jax.jit(jax.vmap(_build_table))
+
+
 _STEP_CACHE: dict = {}
 
 
@@ -266,7 +287,7 @@ class ShardedDeviceConflictSet:
             lo, hi = self._state["lo"], self._state["hi"]
             core = {k: v for k, v in self._state.items()
                     if k not in ("lo", "hi")}
-            core = jax.vmap(lambda s: rebase_state(s, delta))(core)
+            core = _compiled_vmapped_rebase()(core, np.int32(delta))
             core["lo"], core["hi"] = lo, hi
             self._state = core
             self.encoder.base_version += delta
@@ -361,9 +382,11 @@ class ShardedDeviceConflictSet:
         recompilation (cuts are state, not program constants)."""
         from jax.sharding import NamedSharding
 
+        from foundationdb_tpu.utils import jaxenv
+
         assert len(new_cut_bytes) == self.n_shards and new_cut_bytes[0] == b""
         K = self.shapes.capacity
-        st = jax.device_get(self._state)
+        st = jaxenv.device_get(self._state)
         vfill = np.int32(self.encoder._clamp_off(at_version))
 
         cuts = np.zeros((self.n_shards + 1, L), dtype=np.uint32)
@@ -433,18 +456,17 @@ class ShardedDeviceConflictSet:
             new_bval[d, :n] = vcat
             new_nb[d] = n
 
-        from foundationdb_tpu.ops.conflict import _build_table
         sharding = NamedSharding(self.mesh, P(RESOLVER_AXIS))
-        bval_dev = jax.device_put(new_bval, sharding)
+        bval_dev = jaxenv.device_put(new_bval, sharding)
         self._state = {
-            "bkeys": jax.device_put(new_bkeys, sharding),
+            "bkeys": jaxenv.device_put(new_bkeys, sharding),
             "bval": bval_dev,
-            "nb": jax.device_put(new_nb, sharding),
+            "nb": jaxenv.device_put(new_nb, sharding),
             "oldest": self._state["oldest"],
-            "table": jax.jit(jax.vmap(_build_table))(bval_dev),
+            "table": _compiled_table_builder()(bval_dev),
             "poisoned": self._state["poisoned"],
-            "lo": jax.device_put(cuts[: self.n_shards], sharding),
-            "hi": jax.device_put(cuts[1:], sharding),
+            "lo": jaxenv.device_put(cuts[: self.n_shards], sharding),
+            "hi": jaxenv.device_put(cuts[1:], sharding),
         }
         self.cut_bytes = list(new_cut_bytes)
         self._load_counts[:] = 0

@@ -162,13 +162,18 @@ class Resolver:
             t.add_system_callback(lambda _f, t=t: self._drain_groups.discard(t))
 
     async def _drain_group(self, seq: int, entries: list):
-        from foundationdb_tpu.ops.conflict import drain_handles
+        from foundationdb_tpu.ops.conflict import drain_and_collect
         loop = self.process.net.loop
         handles = [h for _req, _reply, h in entries]
         err = None
+        results: list | None = None
         try:
             try:
-                await loop.run_blocking(lambda hs=handles: drain_handles(hs))
+                # drain AND materialize off-loop: result() can run the exact
+                # host intra-batch fallback on an unconverged chunk, which
+                # must not eat event-loop time (devlint DEV001)
+                results = await loop.run_blocking(
+                    lambda hs=handles: drain_and_collect(hs))
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise  # killed/displaced mid-drain: die, don't reply
@@ -176,12 +181,12 @@ class Resolver:
             except BaseException as e:  # noqa: BLE001 — fail the whole group
                 err = FDBError("internal_error", str(e))
             await self._drained_seq.when_at_least(seq - 1)
-            for req, reply, handle in entries:
-                if err is None:
-                    try:
-                        statuses = handle.result()
-                    except FDBError as e:  # state overflow: fatal
-                        err = e
+            if results is None:
+                results = [(None, None)] * len(entries)
+            for (req, reply, _handle), (statuses, herr) in zip(entries,
+                                                               results):
+                if err is None and herr is not None:
+                    err = herr  # state overflow: fatal
                 if err is not None:
                     # a truncated state can yield FALSE COMMITS: poison the
                     # resolver so every later (already-dispatched or new)

@@ -109,3 +109,28 @@ def bound_device_discovery(timeout: float | None = None) -> str:
     except Exception:  # noqa: BLE001 — backend already up (and alive): keep it
         return "initialized"
     return "cpu-fallback"
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned transfer choke points (devlint DEV007).
+#
+# All host<->device transfers route through here so every transfer happens
+# (a) after the operator's JAX_PLATFORMS choice is re-asserted and (b) on a
+# backend that already passed bounded discovery — a raw jax.device_put
+# sprinkled elsewhere can be the process's FIRST backend-initializing call
+# and hang on a wedged runtime with no deadline.
+# ---------------------------------------------------------------------------
+
+def device_put(x, sharding=None):
+    """jax.device_put through the platform-honoring choke point."""
+    ensure_platform_honored()
+    import jax
+    return jax.device_put(x, sharding) if sharding is not None \
+        else jax.device_put(x)
+
+
+def device_get(x):
+    """jax.device_get through the platform-honoring choke point."""
+    ensure_platform_honored()
+    import jax
+    return jax.device_get(x)

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Repo lint gate: both rule families over the default target set
+# (foundationdb_tpu/ + scripts/), then baseline drift detection.
+#
+#   scripts/lint.sh             # human output
+#   scripts/lint.sh --github    # ::error annotations for CI runners
+#
+# Exit non-zero on any new violation OR when the committed baseline no
+# longer matches current findings (stale/renamed entries someone forgot
+# to regenerate with --update-baseline).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FORMAT=text
+if [[ "${1:-}" == "--github" ]]; then
+    FORMAT=github
+fi
+
+# Keep the gate itself off the accelerator: the analyzer is pure AST work,
+# and a wedged remote runtime must not be able to hang CI lint.
+export JAX_PLATFORMS=cpu
+
+python -m foundationdb_tpu.analysis --family all --format "$FORMAT"
+python -m foundationdb_tpu.analysis --family all --update-baseline --check

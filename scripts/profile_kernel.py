@@ -19,6 +19,7 @@ from jax import lax
 sys.path.insert(0, "/root/repo")
 import bench
 from foundationdb_tpu.ops import conflict as C
+from foundationdb_tpu.utils import jaxenv
 from foundationdb_tpu.utils.knobs import KNOBS
 
 T = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
@@ -65,8 +66,8 @@ def make_scan(step_kwargs):
 def main():
     warm_np = bench._encode_batches(8, seed=1, version0=WINDOW)
     main_np = bench._encode_batches(NB, seed=2, version0=WINDOW + 8 * bench.VERSION_STEP)
-    warm = jax.device_put(warm_np)
-    stacked = jax.device_put(main_np)
+    warm = jaxenv.device_put(warm_np)
+    stacked = jaxenv.device_put(main_np)
     state0 = C.init_state(shapes, oldest=0)
 
     scan_full = make_scan({})
