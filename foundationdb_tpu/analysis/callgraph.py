@@ -175,6 +175,33 @@ class PackageContext:
             return list(self.methods_by_name.get(func.attr, []))
         return []
 
+    def resolve_call_strict(self, mod: ModuleContext,
+                            call: ast.Call) -> list[FunctionInfo]:
+        """resolve_call without the duck-candidate fallback: only
+        same-module names, `self.m` on a method the enclosing class itself
+        defines, and module-alias dotted calls resolve; an arbitrary
+        receiver resolves to nothing. For rules that HAND OFF tracked state
+        to the callee (protolint's reply closure): duck candidates would
+        claim `queue.append(reply)` hands the reply to SimFile.append."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self._lookup_in_module(mod, func.id)
+            return local or self._resolve_alias(mod, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                for anc in mod.ancestors(call):
+                    if isinstance(anc, ast.ClassDef):
+                        info = self.classes.get(
+                            (mod.relpath, anc.name), {}).get(func.attr)
+                        return [info] if info is not None else []
+            dotted = mod.resolve_dotted(func)
+            if dotted and "." in dotted:
+                modname, attr = dotted.rsplit(".", 1)
+                target = self.by_dotted.get(modname)
+                if target is not None:
+                    return self._lookup_in_module(target, attr)
+        return []
+
     # -------------------------------------------------------------- helpers
 
     def function_of(self, mod: ModuleContext,

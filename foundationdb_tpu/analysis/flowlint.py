@@ -8,12 +8,15 @@ package-level pass for interprocedural rules — and diffs the findings
 against a checked-in baseline of documented grandfathered violations, so
 every new violation fails tier-1 the moment it is written.
 
-Two rule families ride the engine:
+Three rule families ride the engine:
   - flow (rules.py, FLOW001..FLOW006): actor discipline & determinism,
     enforced by tests/test_flowlint.py.
   - dev (devlint.py, DEV001..DEV008): JAX/device discipline on the hot
     path (readbacks, re-traces, transfer choke points), enforced by
     tests/test_devlint.py.
+  - proto (protolint.py, PROTO001..PROTO008): protocol conformance on the
+    RPC/wire layer (token routing, reply-on-all-paths, Python<->C schema
+    parity), enforced by tests/test_protolint.py.
 
 Engine pieces:
   - Finding: one violation, with a line-number-independent identity key
@@ -31,8 +34,8 @@ Engine pieces:
     orphan its documented entries.
 
 Inline suppression: a line containing `# flowlint: ignore[FLOW00X]` (or
-`# devlint: ignore[DEV00X]`, `ignore[all]`, or a comma-separated code
-list) is exempt — for the rare spot where the rule's static approximation
+`# devlint: ignore[DEV00X]`, `# protolint: ignore[PROTO00X]`,
+`ignore[all]`, or a comma-separated code list) is exempt — for the rare spot where the rule's static approximation
 is provably wrong and a baseline entry would be noise.
 """
 
@@ -52,12 +55,17 @@ PACKAGE_NAME = "foundationdb_tpu"
 # the simulated-cluster workloads — sim-visible code in every sense.
 SIM_VISIBLE = ("core", "server", "net", "testing")
 
-FAMILIES = ("flow", "dev")
+FAMILIES = ("flow", "dev", "proto")
 
 
 def rule_family(code: str) -> str:
-    """Family of a rule code: DEV* -> "dev", everything else -> "flow"."""
-    return "dev" if code.startswith("DEV") else "flow"
+    """Family of a rule code: DEV* -> "dev", PROTO* -> "proto", everything
+    else -> "flow"."""
+    if code.startswith("DEV"):
+        return "dev"
+    if code.startswith("PROTO"):
+        return "proto"
+    return "flow"
 
 
 @dataclass(frozen=True)
@@ -139,7 +147,7 @@ class ModuleContext:
         if not 1 <= line <= len(self.lines):
             return False
         text = self.lines[line - 1]
-        for marker in ("flowlint:", "devlint:"):
+        for marker in ("flowlint:", "devlint:", "protolint:"):
             if marker not in text:
                 continue
             tag = text.split(marker, 1)[1]
@@ -234,7 +242,8 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 def active_rules(family: str = "all") -> list[Rule]:
     # importing the rule modules populates the registry
-    from foundationdb_tpu.analysis import devlint, rules  # noqa: F401
+    from foundationdb_tpu.analysis import (  # noqa: F401
+        devlint, protolint, rules)
     out = [cls() for cls in sorted(_REGISTRY, key=lambda c: c.code)]
     if family != "all":
         out = [r for r in out if r.family == family]

@@ -110,6 +110,20 @@ class Promise:
             self.future._set_error(FDBError("broken_promise"))
 
 
+def settle_failed(reply: Promise, e: BaseException) -> None:
+    """Settle a reply promise from a FAILING spawned handler, just before
+    the exception propagates and kills the coroutine. The transport only
+    auto-answers raises from synchronous handlers; a spawned delegate that
+    dies with its reply unsettled wedges the caller until the full RPC
+    timeout (protolint PROTO002). Cancellation maps to broken_promise:
+    forwarding operation_cancelled verbatim would make the remote caller
+    believe its OWN operation was cancelled and kill actors (see
+    ratekeeper._sample's re-raise discipline)."""
+    if isinstance(e, FDBError) and e.name == "operation_cancelled":
+        e = FDBError("broken_promise", "handler cancelled before reply")
+    reply.send_error(e)
+
+
 class PromiseStream:
     """Multi-value stream: send() many values; receivers pop() Futures.
 

@@ -331,7 +331,7 @@ class NetTransport:
             except (OSError, wire.WireError) as e:
                 if isinstance(e, OSError):
                     self._peers.pop(dest.address, None)
-                self._fail_pending(reply_id, "connect/encode failed")
+                self._fail_pending(reply_id, "encode/write failed", dest, e)
             return reply.future
 
         async def send():
@@ -343,17 +343,27 @@ class NetTransport:
             except (OSError, wire.WireError) as e:
                 if isinstance(e, OSError):
                     self._peers.pop(dest.address, None)
-                self._fail_pending(reply_id, "connect/encode failed")
+                self._fail_pending(reply_id, "connect/encode failed", dest, e)
 
         self._spawn(send())
         return reply.future
 
-    def _fail_pending(self, reply_id: int, detail: str):
+    def _fail_pending(self, reply_id: int, detail: str, dest=None,
+                      cause: BaseException | None = None):
         entry = self._pending.pop(reply_id, None)
         if entry is None:
             return
         if entry[2] is not None:
             entry[2].cancel()
+        if dest is not None:
+            # name the endpoint: a bare "connect/encode failed" in a log of
+            # thousands of requests is uncorrelatable with the actor that
+            # wedged on it (import deferred — server.interfaces must stay
+            # free to import net)
+            from foundationdb_tpu.server.interfaces import token_name
+            detail = f"{detail}: {token_name(dest.token)} -> {dest.address}"
+        if cause is not None:
+            detail = f"{detail} ({type(cause).__name__}: {cause})"
         if not entry[0].is_set():
             entry[0].send_error(FDBError("broken_promise", detail))
 

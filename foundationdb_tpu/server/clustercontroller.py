@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.core.future import Future, settle_failed
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.coordination import (
     CandidacyRequest, CoordinatedStateClient, CoordToken, quorum_wait)
@@ -206,6 +206,10 @@ class ClusterController:
                     "transactions_per_second_limit": round(r.tps, 1)}
             except FDBError as e:
                 if e.name == "operation_cancelled":
+                    # CC displaced mid-status: settle before dying, or the
+                    # status client waits out the full RPC timeout
+                    # (protolint PROTO002)
+                    settle_failed(reply, e)
                     raise
                 status["cluster"]["qos"] = {"unreachable": True}
         reply.send(status)

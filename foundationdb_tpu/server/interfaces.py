@@ -19,14 +19,16 @@ from foundationdb_tpu.utils.types import Mutation
 
 
 # Well-known endpoint tokens (fdbrpc/FlowTransport.h WLTOKEN_* pattern).
+# Every token here must be BOTH registered by a role and reachable from a
+# send site (protolint PROTO001); dead declarations were removed — their
+# integers stay retired so a revived token cannot collide with frames from
+# a mixed-version peer (4, 12, 15, 43, 97, 98 are burned).
 class Token:
     MASTER_GET_COMMIT_VERSION = 1
     MASTER_PING = 2
     MASTER_DEPOSE = 3
-    MASTER_GET_CURRENT_VERSION = 4
     PROXY_COMMIT = 10
     PROXY_GET_READ_VERSION = 11
-    PROXY_GET_KEY_LOCATIONS = 12
     PROXY_GET_COMMITTED_VERSION = 13
     PROXY_PING = 14
     RESOLVER_RESOLVE = 20
@@ -37,22 +39,38 @@ class Token:
     STORAGE_GET_KEY_VALUES = 41
     STORAGE_GET_VALUES = 48  # batched point reads
     STORAGE_WATCH_VALUE = 42
-    STORAGE_GET_SHARD_STATE = 43
     TLOG_LOCK = 33
     STORAGE_SET_LOGSYSTEM = 44
     STORAGE_GET_METRICS = 45
     STORAGE_ADD_SHARD = 46
     STORAGE_SET_SHARDS = 47
-    PROXY_UPDATE_SHARDS = 15
     RK_GET_RATE = 80
     QUEUE_STATS = 81
     WORKER_PING = 90
     WORKER_INIT_ROLE = 91
     CC_REGISTER_WORKER = 95
     CC_GET_DBINFO = 96
-    CC_SET_DBINFO = 97
-    CC_GET_WORKERS = 98
     CC_GET_STATUS = 99
+
+
+_TOKEN_NAMES_CACHE: dict[int, str] | None = None
+
+
+def token_name(value: int) -> str:
+    """Reverse lookup for diagnostics: 30 -> "TLOG_COMMIT". Covers
+    CoordToken too; unknown values format as "token:<n>" so log lines stay
+    greppable either way."""
+    global _TOKEN_NAMES_CACHE
+    names = _TOKEN_NAMES_CACHE
+    if names is None:
+        names = {v: k for k, v in vars(Token).items()
+                 if not k.startswith("_") and isinstance(v, int)}
+        from foundationdb_tpu.server.coordination import CoordToken
+        for k, v in vars(CoordToken).items():
+            if not k.startswith("_") and isinstance(v, int):
+                names.setdefault(v, k)
+        _TOKEN_NAMES_CACHE = names
+    return names.get(value, f"token:{value}")
 
 
 # --- master ---

@@ -41,8 +41,6 @@ class Master:
         process.register(Token.MASTER_GET_COMMIT_VERSION, self._on_get_commit_version)
         process.register(Token.MASTER_PING, self._on_ping)
         process.register(Token.MASTER_DEPOSE, self._on_depose)
-        process.register(Token.MASTER_GET_CURRENT_VERSION,
-                         self._on_get_current_version)
         self._lease_task = None
         if self.coordinators:
             self._lease_task = process.spawn(self._cstate_lease_loop(),
@@ -60,16 +58,6 @@ class Master:
             reply.send_error(FDBError("master_recovery_failed", "deposed"))
         else:
             reply.send(self.epoch)
-
-    def _on_get_current_version(self, req, reply):
-        """Read-only version fence (NO allocation — an allocated-but-never-
-        committed version would be a permanent gap in the resolvers'
-        prevVersion chain). Every version allocated after this reply is
-        strictly greater."""
-        if self.deposed:
-            reply.send_error(FDBError("master_recovery_failed", "deposed"))
-        else:
-            reply.send(self.last_version_assigned)
 
     def _on_depose(self, req, reply):
         """Fast-path fence from the recovering cluster controller; the cstate

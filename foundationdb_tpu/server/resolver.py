@@ -13,6 +13,7 @@ reference (ops/conflict_oracle.py). Both make identical decisions (tested).
 
 from __future__ import annotations
 
+from foundationdb_tpu.core.future import settle_failed
 from foundationdb_tpu.core.notified import AsyncTrigger, NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
 from foundationdb_tpu.ops.conflict import DeviceConflictSet
@@ -117,7 +118,14 @@ class Resolver:
         self.process.spawn(self._resolve_batch(req, reply), "resolveBatch")
 
     async def _resolve_batch(self, req: ResolveTransactionBatchRequest, reply):
-        await self.version.when_at_least(req.prev_version)
+        try:
+            await self.version.when_at_least(req.prev_version)
+        except FDBError as e:
+            # displaced/cancelled while parked on the version gate: settle
+            # before dying, or the proxy waits out the full RPC timeout
+            # (protolint PROTO002)
+            settle_failed(reply, e)
+            raise
         if self._poisoned is not None:
             reply.send_error(self._poisoned)
             return
@@ -128,7 +136,7 @@ class Resolver:
             # unknown old version: a retransmit from before our recovery —
             # drop (the reply may still be draining); the proxy retries and
             # finds the cached reply once the drain lands
-            return
+            return  # protolint: ignore[PROTO002] — deliberate drop, see above
         cs = self.conflict_set
         if self._pipelined:
             # Enqueue transfer+compute now — device state is updated at

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from collections import deque
 
+from foundationdb_tpu.core.future import settle_failed
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import (
@@ -272,7 +273,14 @@ class StorageServer:
         # catch up to the fence FIRST (ingestion must still be running):
         # mutations at versions <= fence may have been routed only to the
         # old team, so a snapshot below the fence would miss them here
-        await self.version.when_at_least(req.fence_version)
+        try:
+            await self.version.when_at_least(req.fence_version)
+        except FDBError as e:
+            # displaced/cancelled while parked on the fence: settle before
+            # dying, or the data distributor's move waits out the full RPC
+            # timeout before retrying (protolint PROTO002)
+            settle_failed(reply, e)
+            raise
         if self._ingest_gate is not None:
             # a second splice started while we awaited the fence; taking over
             # its gate/idle futures would strand it forever — retry next round

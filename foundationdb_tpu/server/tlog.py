@@ -26,6 +26,7 @@ from collections import deque
 
 from foundationdb_tpu.utils import wire
 
+from foundationdb_tpu.core.future import settle_failed
 from foundationdb_tpu.core.notified import NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
 from foundationdb_tpu.server.interfaces import (
@@ -91,7 +92,14 @@ class TLog:
         if self.locked:
             reply.send_error(FDBError("tlog_stopped"))
             return
-        await self.version.when_at_least(req.prev_version)
+        try:
+            await self.version.when_at_least(req.prev_version)
+        except FDBError as e:
+            # displaced/cancelled while parked on the version gate: settle
+            # before dying, or the proxy's commit pipeline waits out the
+            # full RPC timeout (protolint PROTO002)
+            settle_failed(reply, e)
+            raise
         if self.locked:
             reply.send_error(FDBError("tlog_stopped"))
             return
@@ -147,7 +155,14 @@ class TLog:
         # long-poll: block until there is something at/after `begin`
         # (reference peek waits for version growth, TLogServer.actor.cpp)
         from foundationdb_tpu.utils.knobs import KNOBS
-        await self.version.when_at_least(req.begin)
+        try:
+            await self.version.when_at_least(req.begin)
+        except FDBError as e:
+            # displaced/cancelled mid-long-poll: settle before dying, or the
+            # peeking log router / storage waits out the full RPC timeout
+            # (protolint PROTO002)
+            settle_failed(reply, e)
+            raise
         budget = KNOBS.TLOG_PEEK_REPLY_BYTES
         tag = req.tag
         out: list[tuple[int, list]] = []

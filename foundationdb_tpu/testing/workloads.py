@@ -379,7 +379,12 @@ class ConsistencyCheckWorkload(Workload):
                 version=version)
             rows = []
             while True:
-                reply = await db.process.net.request(
+                # a reply-error here (replica rebooting, version aged out)
+                # propagates to the per-shard retry loop below, which
+                # re-reads the WHOLE shard at a fresh version — handling it
+                # per-page would splice rows from two versions
+                reply = await db.process.net.request(  # protolint: ignore[PROTO008]
+
                     db.process,
                     Endpoint(addr_of_tag[tag], Token.STORAGE_GET_KEY_VALUES),
                     req)

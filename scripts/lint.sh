@@ -1,6 +1,8 @@
 #!/usr/bin/env bash
-# Repo lint gate: both rule families over the default target set
-# (foundationdb_tpu/ + scripts/), then baseline drift detection.
+# Repo lint gate: all three rule families (flow, dev, proto) over the
+# default target set (foundationdb_tpu/ + scripts/), then baseline drift
+# detection — with ONE merged exit code, so CI reports every failing gate
+# in a single run instead of stopping at the first.
 #
 #   scripts/lint.sh             # human output
 #   scripts/lint.sh --github    # ::error annotations for CI runners
@@ -8,7 +10,7 @@
 # Exit non-zero on any new violation OR when the committed baseline no
 # longer matches current findings (stale/renamed entries someone forgot
 # to regenerate with --update-baseline).
-set -euo pipefail
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
 FORMAT=text
@@ -20,5 +22,9 @@ fi
 # and a wedged remote runtime must not be able to hang CI lint.
 export JAX_PLATFORMS=cpu
 
-python -m foundationdb_tpu.analysis --family all --format "$FORMAT"
-python -m foundationdb_tpu.analysis --family all --update-baseline --check
+status=0
+python -m foundationdb_tpu.analysis --family all --format "$FORMAT" \
+    || status=$?
+python -m foundationdb_tpu.analysis --family all --update-baseline --check \
+    || status=$?
+exit "$status"

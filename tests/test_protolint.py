@@ -1,0 +1,815 @@
+"""protolint: the PROTO rule family's own tests + tier-1 enforcement.
+
+Mirrors test_devlint.py's three layers:
+  1. Per-rule good/bad snippet fixtures for PROTO001..PROTO008.
+  2. Regressions against the PRE-fix shapes of the real violations this PR
+     fixed (the resolver/tlog/storage fence-await that dies with its reply
+     unsettled, the clustercontroller cancel re-raise, the dead
+     MASTER_GET_CURRENT_VERSION handler) — the linter must catch each one
+     as it was actually written.
+  3. Enforcement: the proto family over the full default target set must
+     be clean against the committed baseline, and the Python<->C schema
+     parity gate must trip when a field is added to only one side
+     (demonstrated by mutating a copy of either registry).
+
+The token census itself is also asserted here (uniqueness + density):
+token ints share one per-process routing namespace, so a duplicate
+silently routes frames to whichever handler registered last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import textwrap
+
+from foundationdb_tpu.analysis import flowlint, protolint
+from foundationdb_tpu.analysis.__main__ import main as flowlint_main
+
+SERVER_PATH = "foundationdb_tpu/server/snippet.py"
+CLIENT_PATH = "foundationdb_tpu/client/snippet.py"
+
+
+def lint(source: str, path: str = SERVER_PATH):
+    """Run only the proto family so flow/dev findings can't muddy
+    assertions."""
+    return flowlint.analyze_source(textwrap.dedent(source), path,
+                                   flowlint.active_rules("proto"))
+
+
+def only(findings, code: str):
+    return [f for f in findings if f.rule == code]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- PROTO001
+
+def test_proto001_flags_duplicate_token_ints():
+    findings = only(lint("""
+        class Token:
+            PING = 1
+            PONG = 1
+
+        class Role:
+            def start(self, net):
+                net.register(Token.PING, self._on)
+                net.register(Token.PONG, self._on)
+
+            def _on(self, req, reply):
+                reply.send(1)
+
+        class Client:
+            async def call(self, net, addr):
+                a = await net.request(net.process, Endpoint(addr, Token.PING), 1)
+                b = await net.request(net.process, Endpoint(addr, Token.PONG), 1)
+                return a + b
+    """), "PROTO001")
+    assert [f.detail for f in findings] == ["Token.PONG"]
+    assert "routes frames" in findings[0].message
+
+
+def test_proto001_flags_sent_but_never_registered():
+    findings = only(lint("""
+        class Token:
+            PING = 1
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING), 1)
+    """), "PROTO001")
+    assert [f.detail for f in findings] == ["Token.PING"]
+    assert "broken_promise" in findings[0].message
+
+
+def test_proto001_flags_registered_but_unreachable():
+    # the pre-fix shape of master's MASTER_GET_CURRENT_VERSION: a handler
+    # registered for a token no send site (direct or indirect) can reach
+    findings = only(lint("""
+        class Token:
+            GET_VERSION = 4
+
+        class Master:
+            def start(self, net):
+                net.register(Token.GET_VERSION, self._on_get_version)
+
+            def _on_get_version(self, req, reply):
+                reply.send(self.version)
+    """), "PROTO001")
+    assert [f.detail for f in findings] == ["Token.GET_VERSION"]
+    assert "dead handler" in findings[0].message
+
+
+def test_proto001_flags_declared_dead_token():
+    findings = only(lint("""
+        class Token:
+            NEVER_USED = 77
+    """), "PROTO001")
+    assert [f.detail for f in findings] == ["Token.NEVER_USED"]
+    assert "dead protocol surface" in findings[0].message
+
+
+def test_proto001_indirect_token_ref_counts_as_reachable():
+    # Token.PING never appears inside an Endpoint ctor, but it is passed
+    # through a helper that picks the destination (the real client's
+    # _pick_proxy(Token.PROXY_COMMIT) pattern) — must stay quiet
+    findings = only(lint("""
+        class Token:
+            PING = 1
+
+        class Role:
+            def start(self, net):
+                net.register(Token.PING, self._on)
+
+            def _on(self, req, reply):
+                reply.send(1)
+
+        class Client:
+            async def call(self):
+                return await self._pick_proxy(Token.PING, 1)
+    """), "PROTO001")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- PROTO002
+
+def test_proto002_flags_early_return_without_settle():
+    findings = only(lint("""
+        class Token:
+            GO = 1
+
+        class Role:
+            def start(self, net):
+                net.register(Token.GO, self._go)
+
+            def _go(self, req, reply):
+                if req.locked:
+                    return
+                reply.send(1)
+    """), "PROTO002")
+    assert [f.detail for f in findings] == ["return-unsettled"]
+
+
+def test_proto002_flags_unguarded_await_in_spawned_coroutine():
+    """The pre-fix resolver/tlog/storage shape: the handler spawns a
+    delegate, and the delegate's fence-await (when_at_least) can raise or
+    be cancelled while the reply is unsettled — the transport only answers
+    raises from SYNC handlers, so the caller wedges until RPC timeout."""
+    findings = only(lint("""
+        class Token:
+            RESOLVE = 1
+
+        class Resolver:
+            def start(self, net):
+                net.register(Token.RESOLVE, self._on_resolve)
+
+            def _on_resolve(self, req, reply):
+                self.loop.spawn(self._resolve_batch(req, reply))
+
+            async def _resolve_batch(self, req, reply):
+                await self.version.when_at_least(req.prev_version)
+                reply.send(self.resolve(req))
+    """), "PROTO002")
+    assert [f.detail for f in findings] == ["raise-unsettled"]
+    assert findings[0].symbol.endswith("_resolve_batch")
+
+
+def test_proto002_settling_try_makes_the_await_quiet():
+    # the post-fix shape: try/except FDBError -> send_error + re-raise
+    findings = only(lint("""
+        class Token:
+            RESOLVE = 1
+
+        class Resolver:
+            def start(self, net):
+                net.register(Token.RESOLVE, self._on_resolve)
+
+            def _on_resolve(self, req, reply):
+                self.loop.spawn(self._resolve_batch(req, reply))
+
+            async def _resolve_batch(self, req, reply):
+                try:
+                    await self.version.when_at_least(req.prev_version)
+                except FDBError as e:
+                    reply.send_error(e)
+                    raise
+                reply.send(self.resolve(req))
+    """), "PROTO002")
+    assert findings == []
+
+
+def test_proto002_sync_handler_raise_is_quiet():
+    # raises from a synchronous handler are answered by the transport
+    # (unknown_error) — only spawned-coroutine raises wedge the caller
+    findings = only(lint("""
+        class Token:
+            GO = 1
+
+        class Role:
+            def start(self, net):
+                net.register(Token.GO, self._go)
+
+            def _go(self, req, reply):
+                if req.bad:
+                    raise ValueError("nope")
+                reply.send(1)
+    """), "PROTO002")
+    assert findings == []
+
+
+def test_proto002_interprocedural_three_hops():
+    # handler -> spawn -> delegate -> helper; the helper falls off the end
+    # with the reply unsettled on one branch, three calls from the register
+    findings = only(lint("""
+        class Token:
+            GO = 1
+
+        class Role:
+            def start(self, net):
+                net.register(Token.GO, self._go)
+
+            def _go(self, req, reply):
+                self.loop.spawn(self._work(req, reply))
+
+            async def _work(self, req, reply):
+                await self._finish(req, reply)
+
+            async def _finish(self, req, reply):
+                if req.ok:
+                    reply.send(1)
+    """), "PROTO002")
+    assert [f.detail for f in findings] == ["fall-unsettled"]
+    assert findings[0].symbol.endswith("_finish")
+
+
+def test_proto002_prefix_cc_cancel_reraise():
+    """Pre-fix ClusterController._get_status shape: the qos except-branch
+    re-raised operation_cancelled without settling the reply first."""
+    findings = only(lint("""
+        class Token:
+            GET_STATUS = 1
+
+        class ClusterController:
+            def start(self, net):
+                net.register(Token.GET_STATUS, self._on_get_status)
+
+            def _on_get_status(self, req, reply):
+                self.loop.spawn(self._get_status(req, reply))
+
+            async def _get_status(self, req, reply):
+                try:
+                    qos = await self._qos_snapshot()
+                except FDBError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                    qos = None
+                reply.send(qos)
+    """), "PROTO002")
+    assert [f.detail for f in findings] == ["raise-unsettled"]
+
+
+# ---------------------------------------------------------------- PROTO003
+
+def test_proto003_flags_inconsistent_request_types():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            PING = 1
+
+        @dataclass
+        class PingRequest:
+            x: int
+
+        @dataclass
+        class OtherRequest:
+            y: int
+
+        class Client:
+            async def a(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING), PingRequest(1))
+
+            async def b(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING), OtherRequest(2))
+    """), "PROTO003")
+    assert len(findings) == 1
+    assert "inconsistent request types" in findings[0].message
+
+
+def test_proto003_flags_handler_annotation_mismatch():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            PING = 1
+
+        @dataclass
+        class PingRequest:
+            x: int
+
+        @dataclass
+        class OtherRequest:
+            y: int
+
+        class Role:
+            def start(self, net):
+                net.register(Token.PING, self._on_ping)
+
+            def _on_ping(self, req: OtherRequest, reply):
+                reply.send(req.y)
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING), PingRequest(1))
+    """), "PROTO003")
+    assert len(findings) == 1
+    assert "OtherRequest" in findings[0].message
+    assert "PingRequest" in findings[0].message
+
+
+def test_proto003_flags_inconsistent_reply_types():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            PING = 1
+
+        @dataclass
+        class PongReply:
+            x: int
+
+        @dataclass
+        class AckReply:
+            ok: bool
+
+        class Role:
+            def start(self, net):
+                net.register(Token.PING, self._on_ping)
+
+            def _on_ping(self, req, reply):
+                if req:
+                    reply.send(PongReply(1))
+                else:
+                    reply.send(AckReply(True))
+    """), "PROTO003")
+    assert len(findings) == 1
+    assert "inconsistent reply types" in findings[0].message
+
+
+# ---------------------------------------------------------------- PROTO004
+
+def test_proto004_flags_unregistered_payload_crossing_transport():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            PING = 1
+
+        @dataclass
+        class PingRequest:
+            x: int
+
+        @dataclass
+        class SneakyRequest:
+            y: int
+
+        def _register_all():
+            return (
+                (1, PingRequest),
+            )
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING),
+                    SneakyRequest(2))
+    """), "PROTO004")
+    assert [f.detail for f in findings] == ["SneakyRequest"]
+    assert "WireError" in findings[0].message
+
+
+def test_proto004_flags_duplicate_wire_id():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class PingRequest:
+            x: int
+
+        @dataclass
+        class PongReply:
+            y: int
+
+        def _register_all():
+            return (
+                (1, PingRequest),
+                (1, PongReply),
+            )
+    """), "PROTO004")
+    assert [f.detail for f in findings] == ["id:1"]
+    assert "wire format" in findings[0].message
+
+
+def test_proto004_flags_unregistered_dataclass_field_type():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Secret:
+            blob: bytes
+
+        @dataclass
+        class PingRequest:
+            inner: Secret
+
+        def _register_all():
+            return (
+                (1, PingRequest),
+            )
+    """), "PROTO004")
+    assert [f.detail for f in findings] == ["PingRequest.inner"]
+    assert "no wire-registry entry" in findings[0].message
+
+
+def test_proto004_registered_payloads_are_quiet():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            PING = 1
+
+        @dataclass
+        class PingRequest:
+            x: int
+
+        def _register_all():
+            return (
+                (1, PingRequest),
+            )
+
+        class Role:
+            def start(self, net):
+                net.register(Token.PING, self._on_ping)
+
+            def _on_ping(self, req, reply):
+                reply.send(req.x)
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.PING), PingRequest(1))
+    """), "PROTO004")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- PROTO005
+
+def _real_c_source() -> str:
+    path = os.path.join(flowlint.default_target(), "native", "fdb_native.c")
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _real_py_view():
+    from foundationdb_tpu.server import interfaces
+    names = ("GetValuesReply", "GetKeyValuesReply")
+    py_fields = {n: [f.name for f in dataclasses.fields(getattr(interfaces, n))]
+                 for n in names}
+    return py_fields, set(names)
+
+
+def test_proto005_parser_reads_the_real_emitters():
+    schemas = {s.name: s for s in protolint.parse_c_schemas(_real_c_source())}
+    assert schemas["GetValuesReply"].fields == ["results"]
+    assert schemas["GetValuesReply"].emit_count == 1
+    assert schemas["GetKeyValuesReply"].fields == ["data", "more", "version"]
+    assert schemas["GetKeyValuesReply"].emit_count == 3
+
+
+def test_proto005_parity_holds_on_the_real_tree():
+    py_fields, registered = _real_py_view()
+    problems = protolint.c_parity_problems(
+        protolint.parse_c_schemas(_real_c_source()), py_fields, registered)
+    assert problems == []
+
+
+def test_proto005_trips_when_python_gains_a_field():
+    """THE acceptance gate: add a field to only the Python side and the
+    parity rule must fail the build."""
+    py_fields, registered = _real_py_view()
+    py_fields["GetValuesReply"] = py_fields["GetValuesReply"] + ["shard_hint"]
+    problems = protolint.c_parity_problems(
+        protolint.parse_c_schemas(_real_c_source()), py_fields, registered)
+    messages = [m for s, m in problems if s.name == "GetValuesReply"]
+    assert any("mis-fills" in m for m in messages)
+    assert any("hard-codes a field count" in m for m in messages)
+
+
+def test_proto005_trips_when_c_gains_a_field():
+    # mutate a COPY of the C registry: the schema comment grows a field the
+    # Python dataclass doesn't have
+    src = _real_c_source().replace(
+        "GetValuesReply { results", "GetValuesReply { shard_hint, results", 1)
+    assert src != _real_c_source()
+    py_fields, registered = _real_py_view()
+    problems = protolint.c_parity_problems(
+        protolint.parse_c_schemas(src), py_fields, registered)
+    messages = [m for s, m in problems if s.name == "GetValuesReply"]
+    assert any("mis-fills" in m for m in messages)
+
+
+def test_proto005_trips_on_emit_count_drift():
+    schema = protolint.CSchema(name="GetValuesReply", fields=["results"],
+                               line=1, emit_count=2)
+    problems = protolint.c_parity_problems(
+        [schema], {"GetValuesReply": ["results"]}, {"GetValuesReply"})
+    assert len(problems) == 1
+    assert "hard-codes a field count of 2" in problems[0][1]
+
+
+def test_proto005_trips_on_schema_with_no_dataclass():
+    schema = protolint.CSchema(name="Phantom", fields=["x"], line=1,
+                               emit_count=None)
+    problems = protolint.c_parity_problems([schema], {}, {"Phantom"})
+    assert len(problems) == 1
+    assert "no matching Python dataclass" in problems[0][1]
+
+
+def test_proto005_unregistered_braces_are_ignored():
+    # prose with braces in a comment must not produce phantom schemas
+    schema = protolint.CSchema(name="whatever", fields=["looks", "like"],
+                               line=1, emit_count=None)
+    assert protolint.c_parity_problems([schema], {}, {"GetValuesReply"}) == []
+
+
+# ---------------------------------------------------------------- PROTO006
+
+def test_proto006_flags_unbounded_remote_wait():
+    findings = only(lint("""
+        class Client:
+            async def call(self, net, ep):
+                return await net.request(net.process, ep, 1, timeout=None)
+    """, CLIENT_PATH), "PROTO006")
+    assert [f.detail for f in findings] == ["timeout=None"]
+
+
+def test_proto006_loop_timeout_wrapper_is_quiet():
+    findings = only(lint("""
+        class Client:
+            async def call(self, net, ep):
+                return await self.loop.timeout(
+                    5.0, net.request(net.process, ep, 1, timeout=None))
+    """, CLIENT_PATH), "PROTO006")
+    assert findings == []
+
+
+def test_proto006_default_timeout_is_quiet():
+    findings = only(lint("""
+        class Client:
+            async def call(self, net, ep):
+                return await net.request(net.process, ep, 1)
+    """, CLIENT_PATH), "PROTO006")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- PROTO007
+
+def test_proto007_flags_request_num_without_epoch():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class AllocRequest:
+            request_num: int
+    """), "PROTO007")
+    assert len(findings) == 1
+    assert "no epoch fence" in findings[0].message
+
+
+def test_proto007_flags_handler_that_never_dedups():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            ALLOC = 1
+
+        @dataclass
+        class AllocRequest:
+            request_num: int
+            epoch: int
+
+        class Role:
+            def start(self, net):
+                net.register(Token.ALLOC, self._on_alloc)
+
+            def _on_alloc(self, req, reply):
+                reply.send(self.allocate(req.epoch))
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.ALLOC),
+                    AllocRequest(1, 2))
+    """), "PROTO007")
+    assert [f.detail for f in findings] == ["AllocRequest->_on_alloc"]
+    assert "re-executed" in findings[0].message
+
+
+def test_proto007_dedup_reading_handler_is_quiet():
+    findings = only(lint("""
+        from dataclasses import dataclass
+
+        class Token:
+            ALLOC = 1
+
+        @dataclass
+        class AllocRequest:
+            request_num: int
+            epoch: int
+
+        class Role:
+            def start(self, net):
+                net.register(Token.ALLOC, self._on_alloc)
+
+            def _on_alloc(self, req, reply):
+                cached = self.dedup.get((req.epoch, req.request_num))
+                if cached is not None:
+                    reply.send(cached)
+                    return
+                reply.send(self.allocate(req.epoch))
+
+        class Client:
+            async def call(self, net, addr):
+                return await net.request(
+                    net.process, Endpoint(addr, Token.ALLOC),
+                    AllocRequest(1, 2))
+    """), "PROTO007")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- PROTO008
+
+def test_proto008_flags_unguarded_request_in_long_loop():
+    findings = only(lint("""
+        class Puller:
+            async def run(self, net, ep):
+                while True:
+                    r = await net.request(net.process, ep, 1)
+                    self.apply(r)
+    """), "PROTO008")
+    assert [f.detail for f in findings] == ["unguarded-await"]
+    assert "reply-error" in findings[0].message
+
+
+def test_proto008_try_inside_the_loop_is_quiet():
+    findings = only(lint("""
+        class Puller:
+            async def run(self, net, ep):
+                while True:
+                    try:
+                        r = await net.request(net.process, ep, 1)
+                    except FDBError:
+                        continue
+                    self.apply(r)
+    """), "PROTO008")
+    assert findings == []
+
+
+def test_proto008_try_outside_the_loop_is_quiet():
+    # the real storage fetch-loop shape: the try that converts "actor dies"
+    # into a handled exit sits OUTSIDE the while — still guarded
+    findings = only(lint("""
+        class Fetcher:
+            async def fetch(self, net, ep):
+                try:
+                    while self.alive:
+                        r = await net.request(net.process, ep, 1)
+                        self.apply(r)
+                except FDBError:
+                    return
+    """), "PROTO008")
+    assert findings == []
+
+
+# ------------------------------------------------- token census (satellite)
+
+def _census():
+    from foundationdb_tpu.server.coordination import CoordToken
+    from foundationdb_tpu.server.interfaces import Token
+    toks = {f"Token.{k}": v for k, v in vars(Token).items()
+            if not k.startswith("_") and isinstance(v, int)}
+    toks.update({f"CoordToken.{k}": v for k, v in vars(CoordToken).items()
+                 if not k.startswith("_") and isinstance(v, int)})
+    return toks
+
+
+# ints retired by removed endpoints; never rebind them (a stale peer built
+# before the removal would route its frames into the new handler)
+BURNED = {4, 12, 15, 43, 97, 98}
+
+
+def test_token_values_are_unique_across_the_routing_namespace():
+    toks = _census()
+    values = list(toks.values())
+    dupes = {v: [k for k, v2 in toks.items() if v2 == v]
+             for v in values if values.count(v) > 1}
+    assert dupes == {}, f"duplicate token ints: {dupes}"
+
+
+def test_token_values_stay_dense_and_off_the_burned_list():
+    toks = _census()
+    values = set(toks.values())
+    assert not values & BURNED, "a retired token int was rebound"
+    # density: the table is a small dense namespace (role-decade blocks),
+    # not scattered magic numbers — new tokens extend a decade, and the
+    # burned ints sit inside the allocated range (retired, not future)
+    assert all(0 < v < 100 for v in values)
+    assert all(b < max(values) for b in BURNED)
+
+
+def test_token_name_reverse_lookup():
+    from foundationdb_tpu.server.interfaces import Token, token_name
+    assert token_name(Token.TLOG_COMMIT) == "TLOG_COMMIT"
+    assert token_name(60) == "GENERATION_READ"  # CoordToken covered too
+    assert token_name(12345) == "token:12345"
+    toks = _census()
+    # every bound value must round-trip to exactly its own name
+    for name, value in toks.items():
+        assert token_name(value) == name.split(".", 1)[1]
+
+
+# ---------------------------------------------------------- output / CLI
+
+def test_protolint_inline_suppression_tag():
+    findings = lint("""
+        class Client:
+            async def call(self, net, ep):
+                return await net.request(net.process, ep, 1, timeout=None)  # protolint: ignore[PROTO006]
+    """, CLIENT_PATH)
+    assert findings == []
+
+
+def test_github_format_annotates_proto_findings():
+    findings = only(lint("""
+        class Client:
+            async def call(self, net, ep):
+                return await net.request(net.process, ep, 1, timeout=None)
+    """, CLIENT_PATH), "PROTO006")
+    out = flowlint.format_github(findings)
+    assert out.startswith("::")
+    assert "file=foundationdb_tpu/client/snippet.py" in out
+    assert "PROTO006" in out
+
+
+def test_cli_family_flag_selects_proto_rules(capsys):
+    assert flowlint_main(["--family", "proto", "--list-rules"]) == 0
+    codes = [line.split()[0] for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert codes == [f"PROTO00{i}" for i in range(1, 9)]
+
+
+def test_family_scoped_baseline_runs_ignore_proto_entries(tmp_path):
+    """A dev-only run must not report the proto grandfathers stale (and
+    vice versa) — the family filter in apply_baseline."""
+    baseline = flowlint.Baseline(entries=[
+        {"rule": "PROTO006", "path": "p.py", "symbol": "f",
+         "detail": "timeout=None", "reason": "doc"}])
+    new, stale = flowlint.apply_baseline([], baseline, families={"dev"})
+    assert new == [] and stale == []
+    new, stale = flowlint.apply_baseline([], baseline, families={"proto"})
+    assert [e["rule"] for e in stale] == ["PROTO006"]
+
+
+# ------------------------------------------------------------- enforcement
+
+def test_eight_proto_rules_active():
+    codes = [r.code for r in flowlint.active_rules("proto")]
+    assert codes == [f"PROTO00{i}" for i in range(1, 9)]
+
+
+def test_package_and_scripts_clean_under_proto_family():
+    """THE enforcement test for this PR: the proto family over the full
+    default target set (package + scripts/) reports zero non-baselined
+    findings and zero stale entries."""
+    findings = flowlint.analyze_paths(flowlint.default_targets(),
+                                      flowlint.active_rules("proto"))
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    new, stale = flowlint.apply_baseline(findings, baseline,
+                                         families={"proto"})
+    assert new == [], "new violations:\n" + flowlint.format_text(new)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_proto_baseline_entries_are_documented():
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    proto = [e for e in baseline.entries if e["rule"].startswith("PROTO")]
+    for entry in proto:
+        reason = entry.get("reason", "")
+        assert reason and not reason.startswith("FIXME"), (
+            f"undocumented baseline entry: {entry}")

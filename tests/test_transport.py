@@ -281,3 +281,134 @@ def test_networktest_tool_measures_the_wire():
     assert report["mbit_per_sec"] > 0
     cli.close()
     srv.close()
+
+
+def test_framing_fuzz_rejects_garbage_without_wedging():
+    """Framing robustness: random bodies, truncated frames, corrupted CRC,
+    unknown-kind bytes, and missing connect magic thrown at a live listener
+    must all be rejected cleanly — the server never hangs or crashes, and
+    still answers a well-formed request afterwards."""
+    import asyncio
+    import random
+    import zlib
+
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.net import transport as T
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.utils import wire
+
+    loop = RealEventLoop()
+    srv = NetTransport(loop, f"127.0.0.1:{free_port()}")
+    cli = NetTransport(loop, f"127.0.0.1:{free_port()}")
+    srv.start()
+    cli.start()
+    try:
+        srv.process.register(7, lambda payload, reply: reply.send(payload))
+        rng = random.Random(0xF0D8)
+        good_body = wire.dumps("ping")
+
+        def fuzz_bytes(trial: int) -> bytes:
+            noise = bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(1, 64)))
+            shape = trial % 5
+            if shape == 0:  # pure noise: not even a coherent header
+                return noise
+            if shape == 1:  # truncated: header promises more body than sent
+                return T._HEADER.pack(1000, 7, 1, T._REQUEST,
+                                      zlib.crc32(noise)) + noise
+            if shape == 2:  # corrupted CRC on a well-formed frame
+                return T._HEADER.pack(len(good_body), 7, 1, T._REQUEST,
+                                      zlib.crc32(good_body) ^ 0xDEAD
+                                      ) + good_body
+            if shape == 3:  # valid CRC, undecodable body
+                return T._HEADER.pack(len(noise), 7, 1, T._REQUEST,
+                                      zlib.crc32(noise)) + noise
+            # shape 4: unknown frame-kind byte with a decodable body
+            return T._HEADER.pack(len(good_body), 7, 1, 9,
+                                  zlib.crc32(good_body)) + good_body
+
+        async def fuzz():
+            # raw asyncio (not loop.spawn): the fuzz client speaks bytes,
+            # not the package's Future protocol
+            host, port = srv.address.rsplit(":", 1)
+            for trial in range(25):
+                reader, writer = await asyncio.open_connection(host,
+                                                               int(port))
+                if trial % 7 != 0:  # sometimes skip the connect magic too
+                    writer.write(T._CONNECT)
+                writer.write(fuzz_bytes(trial))
+                try:
+                    await writer.drain()
+                except OSError:
+                    pass  # server already dropped us: that IS the rejection
+                writer.close()
+
+        loop.aio.run_until_complete(asyncio.wait_for(fuzz(), 25.0))
+
+        # the listener must still be alive and routing after all that
+        async def call():
+            return await cli.request(cli.process,
+                                     Endpoint(srv.address, 7), "alive")
+
+        assert loop.run_future(loop.spawn(call()), max_time=10.0) == "alive"
+    finally:
+        srv.close()
+        cli.close()
+
+
+def test_read_frame_roundtrip_and_crc_reject():
+    """_frame/_read_frame are inverses, and one flipped body byte is a
+    ConnectionError (checksum), not a mis-delivered payload."""
+    import asyncio
+
+    import pytest
+
+    from foundationdb_tpu.net import transport as T
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.utils import wire
+
+    loop = RealEventLoop()
+    t = NetTransport(loop, "127.0.0.1:1")  # never started: pure framing
+    frame = t._frame(7, 3, T._REPLY, wire.dumps(["hello", 7]))
+
+    def feed(data: bytes):
+        async def go():
+            r = asyncio.StreamReader()
+            r.feed_data(data)
+            r.feed_eof()
+            return await t._read_frame(r)
+        return loop.run_future(loop.spawn(go()), max_time=5.0)
+
+    assert feed(frame) == (7, 3, T._REPLY, ["hello", 7])
+    corrupted = frame[:-1] + bytes([frame[-1] ^ 1])
+    with pytest.raises(ConnectionError):
+        feed(corrupted)
+    truncated = frame[: len(frame) - 3]
+    with pytest.raises(asyncio.IncompleteReadError):
+        feed(truncated)
+
+
+def test_fail_pending_names_endpoint_and_cause():
+    """The broken_promise a failed send produces must carry the token NAME,
+    the peer address, and the causing exception — a bare "connect/encode
+    failed" in a log of thousands of requests is uncorrelatable."""
+    from foundationdb_tpu.core.future import Promise
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.server.interfaces import Token
+
+    loop = RealEventLoop()
+    t = NetTransport(loop, "127.0.0.1:2")  # never started: no I/O here
+    reply = Promise()
+    t._pending[9] = (reply, "10.0.0.8:4500", None)
+    t._fail_pending(9, "connect/encode failed",
+                    dest=Endpoint("10.0.0.8:4500", Token.TLOG_COMMIT),
+                    cause=OSError("connection refused"))
+    fut = reply.future
+    assert fut.is_ready() and fut.is_error()
+    err = fut._result
+    assert err.name == "broken_promise"
+    assert "TLOG_COMMIT" in err.detail
+    assert "10.0.0.8:4500" in err.detail
+    assert "OSError" in err.detail and "connection refused" in err.detail
+    assert 9 not in t._pending
