@@ -61,7 +61,8 @@ def _spawn_server(spec: dict, env: dict) -> subprocess.Popen:
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
 
 
-def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2):
+def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
+                  trace_dir=None):
     from foundationdb_tpu.server.interfaces import Token
 
     txn_knobs = {"CONFLICT_BACKEND": backend}
@@ -161,6 +162,8 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2):
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(_SELF))
+    if trace_dir:
+        env["FDBTPU_TRACE_DIR"] = trace_dir  # span files for trace_analyze
     # the core process hosts the resolver: for the device backend it takes
     # whatever accelerator jax finds (the real TPU on the bench box, CPU
     # otherwise); proxy/storage/client processes stay off the device. The
@@ -302,6 +305,15 @@ def worker_main(spec: dict):
     across workers), run one phase, print a JSON result line."""
     from foundationdb_tpu.net.transport import RealEventLoop
 
+    trace_file = None
+    trace_dir = os.environ.get("FDBTPU_TRACE_DIR")
+    if trace_dir:
+        # client-side spans (Client.GRV / Client.Commit) land next to the
+        # servers' files so trace_analyze sees the whole flow
+        from foundationdb_tpu.utils import trace
+        trace_file = trace.RollingTraceFile(os.path.join(
+            trace_dir, f"trace.client{os.getpid()}.jsonl"))
+        trace.set_sink(trace_file.write)
     loop = RealEventLoop()
     client, db = _make_db(loop, spec["proxies"],
                           [bytes.fromhex(b) for b in spec["boundaries"]],
@@ -316,6 +328,11 @@ def worker_main(spec: dict):
     ops, grv, com, errors = loop.run_future(loop.spawn(main()),
                                             max_time=60.0 + spec["seconds"])
     client.close()
+    if trace_file is not None:
+        from foundationdb_tpu.utils.trace import g_trace_batch, set_sink
+        g_trace_batch.dump()
+        set_sink(None)
+        trace_file.close()
     print(json.dumps({"ops": ops, "grv": _pcts(grv), "commit": _pcts(com),
                       "errors": errors}),
           flush=True)
@@ -331,15 +348,34 @@ def _merge_pcts(parts: list[dict]) -> dict:
             for k in ("p50", "p99")}
 
 
+def _stage_breakdown(trace_dir: str) -> dict | None:
+    """Per-stage commit residency from the run's span trace files (the
+    trace_analyze report, folded into the bench JSON)."""
+    import glob
+
+    from foundationdb_tpu.tools import trace_analyze
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace.*")))
+    if not paths:
+        return None
+    rep = trace_analyze.analyze(trace_analyze.load_events(paths))
+    return {"files": len(paths), "flows": rep["flows"],
+            "spans": rep["spans"], "unmatched": rep["unmatched"],
+            "stages": rep["stages"]}
+
+
 def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         n_proxies: int = 0, n_storage: int = 1,
-        n_client_procs: int = 2) -> dict:
+        n_client_procs: int = 2, trace: bool = False) -> dict:
     """One pass per phase (write, read, 90/10); returns the report dict."""
     from foundationdb_tpu.net.transport import RealEventLoop
 
     tmp = tempfile.mkdtemp(prefix="fdbtpu-bench-")
+    trace_dir = None
+    if trace:
+        trace_dir = os.path.join(tmp, "traces")
+        os.makedirs(trace_dir, exist_ok=True)
     procs, p_proxies, boundaries, p_storages = _boot_cluster(
-        tmp, backend, n_proxies, n_storage)
+        tmp, backend, n_proxies, n_storage, trace_dir=trace_dir)
     report: dict = {"clients": clients, "conflict_backend": backend,
                     "topology": {"proxies": n_proxies, "storage": n_storage,
                                  "client_procs": n_client_procs}}
@@ -351,19 +387,35 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(_SELF))
+    if trace_dir:
+        env["FDBTPU_TRACE_DIR"] = trace_dir
     try:
         # preload with an in-process client
         loop = RealEventLoop()
         client, db = _make_db(loop, p_proxies, boundaries, p_storages)
 
         async def preload():
+            from foundationdb_tpu.utils.errors import FDBError
             for base in range(0, KEYS, 100):
                 async def w(tr, base=base):
                     for i in range(base, base + 100):
                         tr.set(b"k%06d" % i, b"v" * 16)
-                await db.transact(w, max_retries=100)
+                while True:
+                    try:
+                        await db.transact(w, max_retries=100)
+                        break
+                    except FDBError as e:
+                        # a device-backend core can stall for seconds on a
+                        # first-shape XLA compile; the proxy's master lease
+                        # lapses and it fences commits with 1033 until pings
+                        # resume. This client has no coordinators (static
+                        # layout), so transact can't refresh-retry it — ride
+                        # the fence out here instead.
+                        if e.name != "cluster_not_fully_recovered":
+                            raise
+                        await loop.delay(0.25)
 
-        loop.run_future(loop.spawn(preload()), max_time=120.0)
+        loop.run_future(loop.spawn(preload()), max_time=240.0)
         client.close()
 
         per = [clients // n_client_procs] * n_client_procs
@@ -415,6 +467,12 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
             p.terminate()
         for p in procs:
             p.wait(timeout=10)
+    if trace_dir:
+        # after the servers exited: their finally-blocks flush the buffered
+        # span records, so the files are only complete now
+        breakdown = _stage_breakdown(trace_dir)
+        if breakdown is not None:
+            report["stage_breakdown"] = breakdown
     return report
 
 

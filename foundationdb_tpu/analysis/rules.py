@@ -1,4 +1,4 @@
-"""flowlint rules FLOW001..FLOW006: the actor-discipline contract.
+"""flowlint rules FLOW001..FLOW007: the actor-discipline contract.
 
 Each rule encodes one bug class the deterministic simulator cannot tolerate
 (docs/flowlint.md has the narrative; ADVICE round 5 found FLOW002/FLOW003
@@ -390,3 +390,68 @@ class DeviceEvalAtImport(Rule):
                     f"{origin}() evaluated at import time initializes the "
                     f"device backend for every importer; build it lazily "
                     f"inside a function (see ops/conflict.py NEG)")
+
+
+# -------------------------------------------------------------- FLOW007
+
+def _trace_event_root(call: ast.Call) -> ast.Call | None:
+    """Innermost Call of a fluent chain when it constructs a TraceEvent
+    (`TraceEvent(...).detail(...).error(...)`); None otherwise."""
+    node = call
+    while isinstance(node.func, ast.Attribute) \
+            and isinstance(node.func.value, ast.Call):
+        node = node.func.value
+    if isinstance(node.func, ast.Name) and node.func.id == "TraceEvent":
+        return node
+    return None
+
+
+@register
+class UnloggedTraceEvent(Rule):
+    code = "FLOW007"
+    summary = ("TraceEvent built but never .log()'d — the event silently "
+               "vanishes (the reference logs from the destructor; ours "
+               "only on an explicit .log())")
+
+    def check(self, mod: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            # case 1: a fluent chain as a bare expression statement whose
+            # outermost call is not .log()
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                if _trace_event_root(call) is None:
+                    continue
+                last = call.func.attr \
+                    if isinstance(call.func, ast.Attribute) else None
+                if last != "log":
+                    yield self.finding(
+                        mod, call, "TraceEvent",
+                        "TraceEvent chain discarded without .log() — "
+                        "nothing is emitted")
+            # case 2: bound to a name that is never .log()'d in scope
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                tgt = node.targets[0]
+                if _trace_event_root(node.value) is None:
+                    continue
+                outer = node.value.func
+                if isinstance(outer, ast.Attribute) and outer.attr == "log":
+                    continue  # `x = TraceEvent(...).log()` already emitted
+                scope = mod.enclosing_function(node) or mod.tree
+                logged = escaped = False
+                for use in ast.walk(scope):
+                    if not (isinstance(use, ast.Name) and use.id == tgt.id
+                            and isinstance(use.ctx, ast.Load)):
+                        continue
+                    parent = mod.parents.get(use)
+                    if isinstance(parent, ast.Attribute):
+                        if parent.attr == "log":
+                            logged = True
+                        continue  # .detail()/.error() keep the chain alive
+                    escaped = True  # returned / passed along: out of scope
+                if not logged and not escaped:
+                    yield self.finding(
+                        mod, node.value, tgt.id,
+                        f"TraceEvent bound to {tgt.id!r} but never "
+                        f".log()'d in this scope — nothing is emitted")

@@ -23,6 +23,7 @@ from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.rng import DeterministicRandom
+from foundationdb_tpu.utils.trace import g_trace_batch
 
 
 class LocationCache:
@@ -163,6 +164,14 @@ class Database:
         self._read_batch_max = KNOBS.READ_BATCH_MAX
         # per-replica latency model driving read load balance + hedging
         self._replica_stats = ReplicaStats()
+        # client-side span idents (NativeAPI debugTransaction): one sequence
+        # per database, address-prefixed so traces from many client processes
+        # merge without collisions
+        self._span_seq = 0
+
+    def _next_span_id(self, kind: str) -> str:
+        self._span_seq += 1
+        return f"{kind}{self.process.address}.{self._span_seq}"
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)
@@ -260,10 +269,12 @@ class Database:
         await self.loop.delay(KNOBS.GRV_BATCH_INTERVAL)
         waiters, self._grv_waiters = self._grv_waiters, []
         self._grv_armed = False
+        span_id = self._next_span_id("grv")
+        t0 = self.loop.now()
         try:
             reply = await self.process.net.request(
                 self.process, self._pick_proxy(Token.PROXY_GET_READ_VERSION),
-                GetReadVersionRequest())
+                GetReadVersionRequest(debug_id=span_id))
             for w in waiters:
                 if not w.is_ready():
                     w._set(reply)
@@ -271,6 +282,12 @@ class Database:
             for w in waiters:
                 if not w.is_ready():
                     w._set_error(FDBError(e.name, e.detail))
+        finally:
+            # both records after the round trip: a cancelled flush must not
+            # strand an open span in the trace
+            g_trace_batch.span_begin("CommitSpan", span_id, "Client.GRV", at=t0)
+            g_trace_batch.span_end("CommitSpan", span_id, "Client.GRV",
+                                   at=self.loop.now())
 
     async def _ensure_locations(self):
         if not self.locations.valid:
@@ -707,5 +724,18 @@ class Database:
         return self.loop.spawn(watch(), "watch")
 
     def _commit(self, req) -> Future:
-        return self.process.net.request(
+        span_id = self._next_span_id("c")
+        req.debug_id = span_id  # proxy attaches this to its batch span
+        t0 = self.loop.now()
+        f = self.process.net.request(
             self.process, self._pick_proxy(Token.PROXY_COMMIT), req)
+
+        def _close(_f):
+            # emit-on-settle: both records land together whether the commit
+            # succeeded, conflicted, or the proxy died mid-flight
+            g_trace_batch.span_begin("CommitSpan", span_id, "Client.Commit",
+                                     at=t0)
+            g_trace_batch.span_end("CommitSpan", span_id, "Client.Commit",
+                                   at=self.loop.now())
+        f.add_callback(_close)
+        return f

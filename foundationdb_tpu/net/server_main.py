@@ -105,6 +105,16 @@ def main(spec_json: str):
         from foundationdb_tpu.utils.profiler import SamplingProfiler
         sampler = SamplingProfiler()
         sampler.start()
+    trace_file = None
+    trace_dir = os.environ.get("FDBTPU_TRACE_DIR")
+    if trace_dir:
+        # per-process rolling trace file (openTraceFile): span/counter
+        # records land here instead of stderr, named by listen address so
+        # trace_analyze can merge the whole cluster's files
+        from foundationdb_tpu.utils import trace
+        trace_file = trace.RollingTraceFile(os.path.join(
+            trace_dir, f"trace.{spec['listen'].replace(':', '_')}.jsonl"))
+        trace.set_sink(trace_file.write)
     try:
         loop.aio.run_forever()
     finally:
@@ -114,6 +124,11 @@ def main(spec_json: str):
         if sampler is not None:
             sampler.stop()
             sampler.trace_report(who=spec["listen"])
+        if trace_file is not None:
+            from foundationdb_tpu.utils.trace import g_trace_batch, set_sink
+            g_trace_batch.dump()  # buffered span records survive shutdown
+            set_sink(None)
+            trace_file.close()
         net.close()
         del roles
 

@@ -57,6 +57,23 @@ from foundationdb_tpu.ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnConflict
 from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection
+
+# Process-wide device-kernel gauges (merged into RESOLVER_METRICS):
+# dispatch count from detect_async_impl, readback-wait wall seconds from
+# drain_and_collect (perf_counter — wall time by design: the wait happens
+# off-loop, where sim virtual time does not advance).
+kernel_metrics = CounterCollection("ConflictKernel")
+_kernel_dispatches = kernel_metrics.counter("KernelDispatches")
+_readback_waits = kernel_metrics.counter("ReadbackWaits")
+_readback_wait_seconds = kernel_metrics.counter("ReadbackWaitSeconds")
+
+
+def compile_cache_stats() -> dict:
+    """Compile-cache hits/misses across the jitted entry points."""
+    step, scan = _compiled_step.cache_info(), _compiled_scan.cache_info()
+    return {"CompileCacheHits": step.hits + scan.hits,
+            "CompileCacheMisses": step.misses + scan.misses}
 
 L = keylib.NUM_LIMBS  # default key limbs (6 data + 1 length; see ConflictShapes.key_bytes)
 _NEG_INT = -(1 << 30)
@@ -1062,6 +1079,7 @@ def detect_async_impl(engine, txns: list[TxnConflictInfo],
         # the MVCC floor advances once per logical batch (last chunk), so
         # every chunk's too-old check uses the pre-batch floor
         batch["advance_floor"] = np.bool_(i == len(subs) - 1)
+        _kernel_dispatches.increment()
         new_state, statuses, info = step(engine._state, batch)
         engine._state = new_state
         # statuses + intra-eligibility + overflow + convergence fused into
@@ -1215,6 +1233,8 @@ def drain_and_collect(
     milliseconds of host compute the event-loop thread should never eat.
     Errors are returned, not raised — a capacity overflow on one handle
     must not strand the remaining handles' results."""
+    import time
+    t0 = time.perf_counter()
     drain_handles(handles)
     out: list[tuple[list[int] | None, FDBError | None]] = []
     for h in handles:
@@ -1222,6 +1242,8 @@ def drain_and_collect(
             out.append((h.result(), None))
         except FDBError as e:
             out.append((None, e))
+    _readback_waits.increment()
+    _readback_wait_seconds.increment(time.perf_counter() - t0)
     return out
 
 

@@ -37,6 +37,7 @@ from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.types import Mutation, MutationType
 from foundationdb_tpu.utils.keys import partition_boundaries as _partition_boundaries
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
@@ -134,11 +135,18 @@ class ClusterController:
         self._watchers: list = []
         self._incarnations: dict[str, int] = {}
         self._attempt = 0
+        self.counters = CounterCollection("ClusterController",
+                                          str(process.address))
+        self._c_registrations = self.counters.counter("WorkerRegistrations")
+        self._c_recoveries = self.counters.counter("RecoveriesCompleted")
+        self._c_status_reqs = self.counters.counter("StatusRequests")
+        self._counters_task = trace_counters_loop(process, self.counters)
         process.register(Token.CC_REGISTER_WORKER, self._on_register)
         process.register(Token.CC_GET_DBINFO, self._on_get_dbinfo)
         process.register(Token.CC_GET_STATUS, self._on_get_status)
 
     def _on_register(self, req: RegisterWorkerRequest, reply):
+        self._c_registrations.increment()
         self.registry.register(req, self.loop.now())
         reply.send(None)
         # stand-down: a storage worker that hosts no referenced tag (healed
@@ -163,10 +171,41 @@ class ClusterController:
     def _on_get_status(self, req, reply):
         self.process.spawn(self._get_status(reply), "clusterGetStatus")
 
+    def _metrics_targets(self, info) -> list[tuple[str, str, int]]:
+        """(role, address, metrics token) for every live role in the
+        published generation — the workerEventsFetcher fan-out set."""
+        targets: list[tuple[str, str, int]] = []
+        if info.master:
+            targets.append(("master", info.master, Token.MASTER_METRICS))
+        for a in info.proxies:
+            targets.append(("proxy", a, Token.PROXY_METRICS))
+        for a in info.resolvers:
+            targets.append(("resolver", a, Token.RESOLVER_METRICS))
+        last_ep = info.log_epochs[-1] if info.log_epochs else None
+        for a in (last_ep.addrs if last_ep else []):
+            targets.append(("log", a, Token.TLOG_METRICS))
+        for a in sorted({a for a, _t in info.storages}):
+            targets.append(("storage", a, Token.STORAGE_METRICS))
+        if info.ratekeeper:
+            targets.append(("ratekeeper", info.ratekeeper, Token.RK_METRICS))
+        return targets
+
+    async def _fetch_metrics(self, addr: str, token: int):
+        """One role's counter snapshot; None when the role is unreachable
+        (a dead role must not wedge the whole status request)."""
+        try:
+            return await self.loop.timeout(self.net.request(
+                self.process, Endpoint(addr, token), None), 1.0)
+        except FDBError as e:
+            if e.name == "operation_cancelled":
+                raise
+            return None
+
     async def _get_status(self, reply):
         """Status JSON assembled by the CC from every role
         (fdbserver/Status.actor.cpp:1698 clusterGetStatus, schema shape from
         fdbclient/Schemas.cpp — trimmed to what this cluster models)."""
+        self._c_status_reqs.increment()
         info = self.dbinfo
         now = self.loop.now()
         status = {
@@ -196,6 +235,48 @@ class ClusterController:
                          "shard_teams": info.shard_tags},
             },
         }
+        # roles: per-role counter snapshots, fetched CONCURRENTLY — a
+        # sequential sweep with 1s timeouts would make status O(roles)
+        # seconds exactly when parts of the cluster are dead
+        targets = self._metrics_targets(info)
+        futs = [self.loop.spawn(self._fetch_metrics(a, tok), "statusMetrics")
+                for _role, a, tok in targets]
+        roles = [{"role": "cluster_controller",
+                  "address": self.process.address,
+                  "counters": self.counters.as_dict()}]
+        try:
+            for (role, addr, _tok), f in zip(targets, futs):
+                snap = await f
+                entry = {"role": role, "address": addr}
+                if snap is None:
+                    entry["unreachable"] = True
+                else:
+                    entry["counters"] = dict(snap)
+                roles.append(entry)
+        except FDBError as e:
+            # CC displaced (or a fetch died) mid-status: settle before
+            # propagating, or the status client waits out the full RPC
+            # timeout (protolint PROTO002)
+            for f in futs:
+                f.cancel()
+            settle_failed(reply, e)
+            raise
+        status["cluster"]["roles"] = roles
+        # workload: cluster-wide commit traffic summed over the proxy fleet
+        # (Status's workload.transactions/bytes section)
+        workload = {"transactions_started": 0, "transactions_committed": 0,
+                    "transactions_conflicted": 0, "commit_batches": 0,
+                    "mutation_bytes": 0}
+        for entry in roles:
+            if entry["role"] != "proxy" or "counters" not in entry:
+                continue
+            c = entry["counters"]
+            workload["transactions_started"] += c.get("GRVIn", 0)
+            workload["transactions_committed"] += c.get("TxnCommitted", 0)
+            workload["transactions_conflicted"] += c.get("TxnConflicts", 0)
+            workload["commit_batches"] += c.get("CommitBatches", 0)
+            workload["mutation_bytes"] += c.get("MutationBytes", 0)
+        status["cluster"]["workload"] = workload
         # qos: live ratekeeper view (Status's qos section)
         if info.ratekeeper:
             try:
@@ -206,9 +287,6 @@ class ClusterController:
                     "transactions_per_second_limit": round(r.tps, 1)}
             except FDBError as e:
                 if e.name == "operation_cancelled":
-                    # CC displaced mid-status: settle before dying, or the
-                    # status client waits out the full RPC timeout
-                    # (protolint PROTO002)
                     settle_failed(reply, e)
                     raise
                 status["cluster"]["qos"] = {"unreachable": True}
@@ -680,6 +758,7 @@ class ClusterController:
             log_epochs=new_epochs, storages=storages,
             shard_boundaries=boundaries, recovery_state="accepting_commits",
             ratekeeper=rk_addr, shard_tags=shard_tags)
+        self._c_recoveries.increment()
         TraceEvent("CCRecovered", self.process.address) \
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
             .detail("Proxies", len(proxy_addrs)).detail("TLogs", len(tlog_addrs)).log()

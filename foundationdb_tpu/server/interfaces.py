@@ -51,6 +51,15 @@ class Token:
     CC_REGISTER_WORKER = 95
     CC_GET_DBINFO = 96
     CC_GET_STATUS = 99
+    # Per-role counter snapshots for status aggregation (Status.actor.cpp's
+    # workerEventsFetcher analogue): reply is a plain dict of counter
+    # values. Each lives in its role's decade block, skipping burned ints.
+    MASTER_METRICS = 5
+    PROXY_METRICS = 16
+    RESOLVER_METRICS = 21
+    TLOG_METRICS = 34
+    STORAGE_METRICS = 49
+    RK_METRICS = 82
 
 
 _TOKEN_NAMES_CACHE: dict[int, str] | None = None
@@ -107,6 +116,10 @@ class CommitTransactionRequest:
     read_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
     write_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
     mutations: list[Mutation] = field(default_factory=list)
+    # Client-side span id for TraceBatch stitching (NativeAPI's
+    # debugTransaction). Trailing + defaulted: wire-compatible with older
+    # peers (utils/wire.py fills missing trailing fields from defaults).
+    debug_id: str | None = None
 
 
 @dataclass
@@ -121,6 +134,7 @@ class GetReadVersionRequest:
     """MasterProxyInterface.h GetReadVersionRequest (flags/priority subset)."""
 
     priority: int = 0
+    debug_id: str | None = None  # client span id (trailing: wire-compatible)
 
 
 @dataclass

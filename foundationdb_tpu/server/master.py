@@ -24,6 +24,7 @@ from foundationdb_tpu.server.interfaces import (
     GetCommitVersionReply, GetCommitVersionRequest, Token)
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
 
 
 class Master:
@@ -38,17 +39,29 @@ class Master:
         self.last_version_time = self.loop.now()
         # (proxy_id -> (request_num, reply)) retransmit dedupe window
         self._last_reply: dict[int, tuple[int, GetCommitVersionReply]] = {}
+        self.counters = CounterCollection("Master", str(process.address))
+        self._c_requests = self.counters.counter("VersionRequests")
+        self._c_retransmits = self.counters.counter("Retransmits")
+        self._c_versions = self.counters.counter("VersionsAdvanced")
         process.register(Token.MASTER_GET_COMMIT_VERSION, self._on_get_commit_version)
         process.register(Token.MASTER_PING, self._on_ping)
         process.register(Token.MASTER_DEPOSE, self._on_depose)
+        process.register(Token.MASTER_METRICS, self._on_metrics)
+        self._counters_task = trace_counters_loop(process, self.counters)
         self._lease_task = None
         if self.coordinators:
             self._lease_task = process.spawn(self._cstate_lease_loop(),
                                              "masterCstateLease")
 
     def shutdown(self):
+        self._counters_task.cancel()
         if self._lease_task is not None:
             self._lease_task.cancel()
+
+    def _on_metrics(self, req, reply):
+        snap = self.counters.as_dict()
+        snap["LastVersionAssigned"] = self.last_version_assigned
+        reply.send(snap)
 
     def _on_ping(self, req, reply):
         """Proxy liveness lease: a proxy that cannot reach ITS (undeposed)
@@ -113,8 +126,10 @@ class Master:
             reply.send_error(FDBError("master_recovery_failed",
                                       f"epoch {req.epoch} != {self.epoch}"))
             return
+        self._c_requests.increment()
         prev = self._last_reply.get(req.proxy_id)
         if prev is not None and prev[0] == req.request_num:
+            self._c_retransmits.increment()
             reply.send(prev[1])  # retransmit: same version again
             return
         now = self.loop.now()
@@ -123,6 +138,7 @@ class Master:
         version = self.last_version_assigned + advance
         r = GetCommitVersionReply(version=version,
                                   prev_version=self.last_version_assigned)
+        self._c_versions.increment(advance)
         self.last_version_assigned = version
         self.last_version_time = now
         self._last_reply[req.proxy_id] = (req.request_num, r)

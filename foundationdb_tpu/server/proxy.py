@@ -40,6 +40,8 @@ from foundationdb_tpu.core.future import all_of
 from foundationdb_tpu.utils import keys as keylib
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
+from foundationdb_tpu.utils.trace import g_trace_batch
 from foundationdb_tpu.utils.types import (
     Mutation, MutationType, make_versionstamp, substitute_versionstamp)
 
@@ -183,6 +185,15 @@ class Proxy:
         from foundationdb_tpu.utils.trace import LatencyBands
         self.commit_bands = LatencyBands(f"ProxyCommit{proxy_id}")
         self.grv_bands = LatencyBands(f"ProxyGRV{proxy_id}")
+        self.counters = CounterCollection("Proxy", str(process.address))
+        self._c_commits_in = self.counters.counter("TxnCommitIn")
+        self._c_committed = self.counters.counter("TxnCommitted")
+        self._c_conflicts = self.counters.counter("TxnConflicts")
+        self._c_too_old = self.counters.counter("TxnTooOld")
+        self._c_grv_in = self.counters.counter("GRVIn")
+        self._c_batches = self.counters.counter("CommitBatches")
+        self._c_mutation_bytes = self.counters.counter("MutationBytes")
+        self._assembly_t0: float | None = None
         self._infra_failures = 0
         # suicide-on-pipeline-failure only makes sense when a cluster
         # controller exists to observe the death and rebuild the generation;
@@ -194,6 +205,8 @@ class Proxy:
         process.register(Token.PROXY_GET_COMMITTED_VERSION,
                          self._on_get_committed_version)
         process.register(Token.PROXY_PING, self._on_proxy_ping)
+        process.register(Token.PROXY_METRICS, self._on_metrics)
+        self._counters_task = trace_counters_loop(process, self.counters)
         self._lease_task = process.spawn(self._master_lease_loop(), "masterLease")
         self._last_flush = self.loop.now()
         # idle empty batches (the reference's MAX_COMMIT_BATCH_INTERVAL
@@ -235,6 +248,7 @@ class Proxy:
         """Displaced by a newer generation on the same worker."""
         self._lease_task.cancel()
         self._bands_task.cancel()
+        self._counters_task.cancel()
         if self._seed_task is not None:
             self._seed_task.cancel()
         if self._empty_task is not None:
@@ -249,6 +263,12 @@ class Proxy:
 
     def _on_proxy_ping(self, req, reply):
         reply.send(self.epoch)
+
+    def _on_metrics(self, req, reply):
+        snap = self.counters.as_dict()
+        snap["CommittedVersion"] = self.committed_version.get()
+        snap["GRVQueueDepth"] = len(self._grv_queue)
+        reply.send(snap)
 
     def _shards_from_txn_state(self) -> ShardMap:
         """Derive the routing map (keyInfo) from \\xff/keyServers in the
@@ -340,7 +360,8 @@ class Proxy:
         TraceEvent("ProxyDied", self.process.address) \
             .detail("Reason", reason).detail("Epoch", self.epoch).log()
         for token in (Token.PROXY_COMMIT, Token.PROXY_GET_READ_VERSION,
-                      Token.PROXY_GET_COMMITTED_VERSION, Token.PROXY_PING):
+                      Token.PROXY_GET_COMMITTED_VERSION, Token.PROXY_PING,
+                      Token.PROXY_METRICS):
             self.process.deregister(token)
         self.shutdown()
 
@@ -429,6 +450,7 @@ class Proxy:
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy lost its master"))
             return
+        self._c_grv_in.increment()
         if self._rk_tps is not None:
             # ratekeeper-gated: spend a token or wait in line
             if not self._grv_queue and self._grv_tokens >= 1.0:
@@ -484,6 +506,9 @@ class Proxy:
                                       "proxy still seeding txn state"))
             return
         self.stats["commits_in"] += 1
+        self._c_commits_in.increment()
+        if not self._pending:
+            self._assembly_t0 = self.loop.now()  # batch-assembly span start
         self._pending.append((req, reply, self.loop.now()))
         if len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX:
             self._flush()
@@ -501,6 +526,16 @@ class Proxy:
         batch, self._pending = self._pending, []
         self._batch_n += 1
         self._last_flush = self.loop.now()
+        self._c_batches.increment()
+        # the assembly span's begin time predates the batch id, so both
+        # records are emitted here with explicit timestamps
+        bid = f"b{self.proxy_id}.{self._batch_n}"
+        if batch and self._assembly_t0 is not None:
+            g_trace_batch.span_begin("CommitSpan", bid, "Proxy.BatchAssembly",
+                                     at=self._assembly_t0)
+            g_trace_batch.span_end("CommitSpan", bid, "Proxy.BatchAssembly",
+                                   at=self._last_flush)
+        self._assembly_t0 = None
         self.process.spawn(self._commit_batch(self._batch_n, batch), "commitBatch")
 
     def _band_replies(self, t_ins):
@@ -514,18 +549,36 @@ class Proxy:
     # -- the 5-phase pipeline --
 
     async def _commit_batch(self, batch_n: int, batch):
-        from foundationdb_tpu.utils.trace import g_trace_batch
         requests = [req for req, _rep, _t in batch]
         replies = [rep for _req, rep, _t in batch]
         t_ins = [t for _req, _rep, t in batch]
         resolution_started = False
         state_applied = False
         batch_meta: list[list | None] = []  # per request
-        g_trace_batch.add_event("CommitDebug", f"b{self.proxy_id}.{batch_n}",
-                                "Proxy.commitBatch.Before")
+        bid = f"b{self.proxy_id}.{batch_n}"
+        now = self.loop.now
+        # stage spans left open by a failed batch are closed in the except
+        # handler, so the span stream stays well-formed on every path
+        open_spans: list[str] = []
+
+        def _sb(span: str):
+            open_spans.append(span)
+            g_trace_batch.span_begin("CommitSpan", bid, span, at=now())
+
+        def _se(span: str):
+            open_spans.remove(span)
+            g_trace_batch.span_end("CommitSpan", bid, span, at=now())
+
+        g_trace_batch.add_event("CommitDebug", bid,
+                                "Proxy.commitBatch.Before", at=now())
+        for req in requests:
+            if req.debug_id:  # stitch the client's commit span to this batch
+                g_trace_batch.add_attach("CommitAttach", req.debug_id, bid,
+                                         at=now())
         try:
             # ---- Phase 1: pre-resolution (:363) ----
             await self.latest_resolving.when_at_least(batch_n - 1)
+            _sb("Proxy.GetCommitVersion")
             self._request_num += 1
             # RETRY the version fetch with the SAME request_num until the
             # master answers (it dedupes retransmits :834-843): a timed-out
@@ -547,6 +600,11 @@ class Proxy:
                         raise  # master gone: recovery will replace us
                     await self.loop.delay(0.2)
             commit_version, prev_version = ver.version, ver.prev_version
+            _se("Proxy.GetCommitVersion")
+            # stitch the batch to its commit version: resolver + tlog spans
+            # downstream carry v<version> idents
+            g_trace_batch.add_attach("CommitAttach", bid,
+                                     f"v{commit_version}", at=now())
 
             from foundationdb_tpu.server import systemdata
             n_res = len(self.resolvers.endpoints)
@@ -602,6 +660,7 @@ class Proxy:
             # are skipped below — so dispatch needn't wait on the previous
             # batch's phase 3 and resolution stays pipelined
             last_receive = self._last_applied_version
+            _sb("Proxy.Resolve")
             resolve_futures = [
                 self.process.net.request(
                     self.process, self.resolvers.endpoints[r],
@@ -618,12 +677,13 @@ class Proxy:
             resolution_started = True
             self.latest_resolving.set(batch_n)  # pipelining gate (:417)
             g_trace_batch.add_event(
-                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
-                "Proxy.commitBatch.GettingCommitVersion")
+                "CommitDebug", bid,
+                "Proxy.commitBatch.GettingCommitVersion", at=now())
             resolutions = await all_of(resolve_futures)
+            _se("Proxy.Resolve")
             g_trace_batch.add_event(
-                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
-                "Proxy.commitBatch.AfterResolution")
+                "CommitDebug", bid,
+                "Proxy.commitBatch.AfterResolution", at=now())
 
             # ---- Phase 3: post-resolution (:425) ----
             await self.latest_logging.when_at_least(batch_n - 1)
@@ -688,6 +748,7 @@ class Proxy:
 
             messages: dict[int, list[Mutation]] = {}
             batch_order = 0
+            mutation_bytes = 0
             blog: list[Mutation] = []  # backup tee (:664-776)
             # per-mutation loop: hoist attribute lookups and skip the
             # backup scan when no backup ranges are registered
@@ -707,6 +768,7 @@ class Proxy:
                     if mt == vs_key or mt == vs_val:
                         m = self._substitute(m, stamp)
                         mt = m.type
+                    mutation_bytes += len(m.param1) + len(m.param2)
                     if mt == clear_t:
                         tags = tags_for_range(m.param1, m.param2)
                     else:
@@ -721,6 +783,7 @@ class Proxy:
                             if systemdata.mutation_overlaps(m, rb_, re_):
                                 blog.append(m)
                                 break
+            self._c_mutation_bytes.increment(mutation_bytes)
             if blog:
                 # tee into \xff/blog/<version><seq> INSIDE the same batch:
                 # the log row commits atomically with the data it records
@@ -737,9 +800,11 @@ class Proxy:
             # ---- Phase 4: logging (:835) ----
             # push through the log system: per-set quorum (primary
             # N - antiquorum, plus every satellite set's own quorum)
+            _sb("Proxy.TLogPush")
             await self.log_system.push(
                 prev_version, commit_version, messages,
                 self.committed_version.get())
+            _se("Proxy.TLogPush")
             # monotonic: a LATER batch that failed early (before its phase-3
             # gate) already max-set this past batch_n in its except handler;
             # a plain set would throw and abort this healthy batch
@@ -747,8 +812,9 @@ class Proxy:
 
             # ---- Phase 5: replies (:862) ----
             g_trace_batch.add_event(
-                "CommitDebug", f"b{self.proxy_id}.{batch_n}",
-                "Proxy.commitBatch.AfterLogPush")
+                "CommitDebug", bid,
+                "Proxy.commitBatch.AfterLogPush", at=now())
+            _sb("Proxy.Reply")
             self._band_replies(t_ins)
             self._infra_failures = 0
             if commit_version > self.committed_version.get():
@@ -757,14 +823,18 @@ class Proxy:
             for rep, status in zip(replies, statuses):
                 if status == COMMITTED:
                     self.stats["committed"] += 1
+                    self._c_committed.increment()
                     acked_any = True
                     rep.send(CommitReply(version=commit_version))
                 elif status == TOO_OLD:
                     self.stats["too_old"] += 1
+                    self._c_too_old.increment()
                     rep.send_error(FDBError("transaction_too_old"))
                 else:
                     self.stats["conflicts"] += 1
+                    self._c_conflicts.increment()
                     rep.send_error(FDBError("not_committed"))
+            _se("Proxy.Reply")
             if acked_any:
                 # sim-only oracle (debug_advanceMaxCommittedVersion,
                 # MasterProxyServer.actor.cpp:820): acked versions are
@@ -776,6 +846,9 @@ class Proxy:
         except Exception as e:  # noqa: BLE001
             # a failed stage fails the whole batch; clients retry
             # (commit_unknown_result semantics: the batch may have logged)
+            for span in reversed(open_spans):
+                g_trace_batch.span_end("CommitSpan", bid, span, at=now())
+            open_spans.clear()
             self.latest_resolving.set(max(self.latest_resolving.get(), batch_n))
             self.latest_logging.set(max(self.latest_logging.get(), batch_n))
             detail = getattr(e, "name", type(e).__name__)

@@ -21,6 +21,7 @@ from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.interfaces import Token
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
 
 
 @dataclass
@@ -46,14 +47,30 @@ class Ratekeeper:
         self.storages = list(storages or [])
         self.tps = KNOBS.RK_BASE_TPS
         self.stats = {"worst_tlog_bytes": 0, "worst_storage_lag": 0}
+        self.counters = CounterCollection("Ratekeeper", str(process.address))
+        self._c_rate_reqs = self.counters.counter("RateRequests")
+        self._c_updates = self.counters.counter("UpdateRounds")
+        # control-loop gauges (set, not incremented): the last sampled worsts
+        # and the current budget
+        self._g_tps = self.counters.counter("TPS")
+        self._g_worst_log = self.counters.counter("WorstTLogBytes")
+        self._g_worst_lag = self.counters.counter("WorstStorageLag")
+        self._g_tps.set(self.tps)
         process.register(Token.RK_GET_RATE, self._on_get_rate)
+        process.register(Token.RK_METRICS, self._on_metrics)
         self._task = process.spawn(self._update_loop(), "rateKeeper")
+        self._counters_task = trace_counters_loop(process, self.counters)
 
     def shutdown(self):
         self._task.cancel()
+        self._counters_task.cancel()
+
+    def _on_metrics(self, req, reply):
+        reply.send(self.counters.as_dict())
 
     def _on_get_rate(self, req, reply):
         n = max(1, req if isinstance(req, int) else 1)  # proxies share the budget
+        self._c_rate_reqs.increment()
         reply.send(RateInfoReply(tps=self.tps / n))
 
     async def _sample(self, addr: str) -> QueueStatsReply | None:
@@ -86,6 +103,11 @@ class Ratekeeper:
                     worst_lag = max(worst_lag, s.lag_versions)
             self.stats["worst_tlog_bytes"] = worst_log
             self.stats["worst_storage_lag"] = worst_lag
+            self._c_updates.increment()
+            # Counter gauges, not promise gates: nothing awaits them,
+            # so no settle discipline applies
+            self._g_worst_log.set(worst_log)  # flowlint: ignore[FLOW002]
+            self._g_worst_lag.set(worst_lag)  # flowlint: ignore[FLOW002]
 
             scale = 1.0
             if worst_log > KNOBS.RK_TARGET_TLOG_BYTES:
@@ -95,4 +117,5 @@ class Ratekeeper:
                             KNOBS.RK_TARGET_STORAGE_LAG_VERSIONS / worst_lag)
             target = KNOBS.RK_BASE_TPS * scale
             self.tps = (1 - smoothing) * self.tps + smoothing * target
+            self._g_tps.set(round(self.tps, 2))  # flowlint: ignore[FLOW002]
             await self.loop.delay(KNOBS.RK_UPDATE_INTERVAL)

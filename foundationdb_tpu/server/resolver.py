@@ -22,6 +22,8 @@ from foundationdb_tpu.server.interfaces import (
     ResolveTransactionBatchReply, ResolveTransactionBatchRequest, Token)
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
+from foundationdb_tpu.utils.trace import g_trace_batch
 
 
 def new_conflict_set(oldest_version: int = 0):
@@ -105,14 +107,35 @@ class Resolver:
         self._poisoned: FDBError | None = None
         self._drain_task = (process.spawn(self._drain_loop(), "resolverDrain")
                             if self._pipelined else None)
+        self.counters = CounterCollection("Resolver", str(process.address))
+        self._c_batches = self.counters.counter("BatchesIn")
+        self._c_txns = self.counters.counter("TxnResolved")
+        self._c_groups = self.counters.counter("DrainGroups")
         process.register(Token.RESOLVER_RESOLVE, self._on_resolve)
+        process.register(Token.RESOLVER_METRICS, self._on_metrics)
+        self._counters_task = trace_counters_loop(process, self.counters)
 
     def shutdown(self):
         """Displaced by a re-created resolver on the same worker."""
+        self._counters_task.cancel()
         if self._drain_task is not None:
             self._drain_task.cancel()
         for t in list(self._drain_groups):
             t.cancel()
+
+    def _on_metrics(self, req, reply):
+        """Role counters + the process-wide device gauges (transfer bytes,
+        kernel dispatches, readback wait, compile cache) the reference never
+        needed — a resolver is the only role that drives the device."""
+        from foundationdb_tpu.ops import conflict
+        from foundationdb_tpu.utils import jaxenv
+        snap = self.counters.as_dict()
+        snap["Version"] = self.version.get()
+        snap["Backend"] = getattr(self.conflict_set, "backend_label", "oracle")
+        snap.update(conflict.kernel_metrics.as_dict())
+        snap.update(conflict.compile_cache_stats())
+        snap.update(jaxenv.transfer_metrics.as_dict())
+        reply.send(snap)
 
     def _on_resolve(self, req: ResolveTransactionBatchRequest, reply):
         self.process.spawn(self._resolve_batch(req, reply), "resolveBatch")
@@ -138,17 +161,28 @@ class Resolver:
             # finds the cached reply once the drain lands
             return  # protolint: ignore[PROTO002] — deliberate drop, see above
         cs = self.conflict_set
+        self._c_batches.increment()
+        loop = self.process.net.loop
+        vid = f"v{req.version}"
         if self._pipelined:
             # Enqueue transfer+compute now — device state is updated at
             # dispatch in version order, so the NEXT batch may dispatch as
             # soon as version advances; the verdict readback happens in the
             # drain loop without ever blocking dispatch.
+            g_trace_batch.span_begin("CommitSpan", vid, "Resolver.Dispatch",
+                                     at=loop.now())
             handle = cs.detect_async(req.transactions, req.version)
+            g_trace_batch.span_end("CommitSpan", vid, "Resolver.Dispatch",
+                                   at=loop.now())
             self.version.set(req.version)
             self._drain_pending.append((req, reply, handle))
             self._drain_wake.trigger()
             return
+        g_trace_batch.span_begin("CommitSpan", vid, "Resolver.Dispatch",
+                                 at=loop.now())
         statuses = cs.detect(req.transactions, req.version)
+        g_trace_batch.span_end("CommitSpan", vid, "Resolver.Dispatch",
+                               at=loop.now())
         self.version.set(req.version)
         self._finish_batch(req, reply, statuses)
 
@@ -175,13 +209,25 @@ class Resolver:
         handles = [h for _req, _reply, h in entries]
         err = None
         results: list | None = None
+        self._c_groups.increment()
         try:
             try:
                 # drain AND materialize off-loop: result() can run the exact
                 # host intra-batch fallback on an unconverged chunk, which
                 # must not eat event-loop time (devlint DEV001)
+                t_rb0 = loop.now()
                 results = await loop.run_blocking(
                     lambda hs=handles: drain_and_collect(hs))
+                # per-entry readback spans, emitted only once the wait
+                # completed (a cancel mid-drain must not leave open spans);
+                # all entries in a group share one device sync, so they
+                # share its window
+                t_rb1 = loop.now()
+                for req, _reply, _h in entries:
+                    g_trace_batch.span_begin("CommitSpan", f"v{req.version}",
+                                             "Resolver.ReadbackWait", at=t_rb0)
+                    g_trace_batch.span_end("CommitSpan", f"v{req.version}",
+                                           "Resolver.ReadbackWait", at=t_rb1)
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise  # killed/displaced mid-drain: die, don't reply
@@ -227,6 +273,7 @@ class Resolver:
         (drain preserves dispatch order, so batch N's state txns are always
         recorded before batch N+1 assembles its catch-up window)."""
         self.total_resolved += len(req.transactions)
+        self._c_txns.increment(len(req.transactions))
 
         # record this batch's state txns with the LOCAL verdict; proxies AND
         # verdicts across resolvers for the global one (:452-459 in the proxy)

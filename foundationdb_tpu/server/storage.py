@@ -28,6 +28,7 @@ from foundationdb_tpu.server.versioned_map import make_versioned_map
 from foundationdb_tpu.storage.kvstore import MemoryKeyValueStore
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
 from foundationdb_tpu.utils.types import Mutation, MutationType
 from foundationdb_tpu.utils import wire
 
@@ -140,6 +141,14 @@ class StorageServer:
         process.register(Token.STORAGE_GET_METRICS, self._on_get_metrics)
         process.register(Token.STORAGE_ADD_SHARD, self._on_add_shard)
         process.register(Token.STORAGE_SET_SHARDS, self._on_set_shards)
+        self.counters = CounterCollection("Storage", str(process.address))
+        self._c_point_reads = self.counters.counter("PointReads")
+        self._c_batch_reads = self.counters.counter("BatchReadKeys")
+        self._c_range_reads = self.counters.counter("RangeReads")
+        self._c_watches = self.counters.counter("Watches")
+        self._c_mutations = self.counters.counter("MutationsApplied")
+        process.register(Token.STORAGE_METRICS, self._on_metrics)
+        self._counters_task = trace_counters_loop(process, self.counters)
         self._ingest_gate: object | None = None  # set while fetchKeys runs
         self._ingest_idle: object | None = None  # update loop parked handshake
         from foundationdb_tpu.server.logsystem import PeekCursor
@@ -155,6 +164,14 @@ class StorageServer:
     def shutdown(self):
         """Displaced by a re-created storage role on the same worker."""
         self._pull_task.cancel()
+        self._counters_task.cancel()
+
+    def _on_metrics(self, req, reply):
+        snap = self.counters.as_dict()
+        snap["Version"] = self.version.get()
+        snap["DurableVersion"] = self.durable_version
+        snap["LagVersions"] = self.version.get() - self.durable_version
+        reply.send(snap)
 
     # -- recovery (rollback :2211 + log-system rebind) --
 
@@ -388,6 +405,7 @@ class StorageServer:
                     break  # next iteration peeks the successor epoch
                 for m in muts:
                     self.data.apply(version, m)
+                self._c_mutations.increment(len(muts))
                 self._pending_durable.append((version, muts))
                 self._peek_begin = version
                 if version > self.version.get():
@@ -512,6 +530,7 @@ class StorageServer:
         self.process.spawn(self._get_value(req, reply), "getValueQ")
 
     async def _get_value(self, req: GetValueRequest, reply):
+        self._c_point_reads.increment()
         try:
             if not self._owns_key(req.key):
                 raise FDBError("wrong_shard_server")
@@ -534,6 +553,7 @@ class StorageServer:
         (reply.wants_bytes) the C store serializes the GetValuesReply frame
         itself, so the reply never exists as per-KV Python objects."""
         from foundationdb_tpu.server.interfaces import GetValuesReply
+        self._c_batch_reads.increment(len(req.reads))
         try:
             await self._wait_for_version(max(v for _k, v in req.reads))
         except FDBError as e:
@@ -571,6 +591,7 @@ class StorageServer:
         self.process.spawn(self._get_key_values(req, reply), "getKeyValues")
 
     async def _get_key_values(self, req: GetKeyValuesRequest, reply):
+        self._c_range_reads.increment()
         try:
             if not self._owns_range(req.begin.key, req.end.key):
                 raise FDBError("wrong_shard_server")
@@ -602,6 +623,7 @@ class StorageServer:
         self.process.spawn(self._watch(req, reply), "watchValue")
 
     async def _watch(self, req: WatchValueRequest, reply):
+        self._c_watches.increment()
         try:
             if not self._owns_key(req.key):
                 raise FDBError("wrong_shard_server")

@@ -34,6 +34,8 @@ from foundationdb_tpu.server.interfaces import (
     TLogPeekReply, TLogPeekRequest, TLogPopRequest, Token)
 from foundationdb_tpu.storage.diskqueue import DiskQueue
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
+from foundationdb_tpu.utils.trace import g_trace_batch
 from foundationdb_tpu.utils.types import mutations_weight
 
 
@@ -56,12 +58,29 @@ class TLog:
         # queue signal — grows while a storage server is not consuming
         self._tag_sizes: dict[int, deque] = {}  # tag -> deque[(version, bytes)]
         self._tag_bytes: dict[int, int] = {}
+        self.counters = CounterCollection("TLog", str(process.address))
+        self._c_commits = self.counters.counter("Commits")
+        self._c_bytes_in = self.counters.counter("BytesIn")
+        self._c_peeks = self.counters.counter("Peeks")
+        self._c_pops = self.counters.counter("Pops")
         if register:
             process.register(Token.TLOG_COMMIT, self._on_commit)
             process.register(Token.TLOG_PEEK, self._on_peek)
             process.register(Token.TLOG_POP, self._on_pop)
             process.register(Token.TLOG_LOCK, self._on_lock)
             process.register(Token.QUEUE_STATS, self._on_queue_stats)
+            process.register(Token.TLOG_METRICS, self._on_metrics)
+            trace_counters_loop(process, self.counters)
+
+    def _metrics_snapshot(self) -> dict:
+        snap = self.counters.as_dict()
+        snap["DurableVersion"] = self.version.get()
+        snap["QueueBytes"] = sum(self._tag_bytes.values())
+        snap["MemBytes"] = self._mem_bytes
+        return snap
+
+    def _on_metrics(self, req, reply):
+        reply.send(self._metrics_snapshot())
 
     def _on_queue_stats(self, req, reply):
         """TLogQueuingMetrics for the ratekeeper: total un-popped bytes
@@ -106,9 +125,11 @@ class TLog:
         if req.version <= self.version.get():
             reply.send(TLogCommitReply(version=self.version.get()))  # duplicate
             return
+        bytes_in = 0
         for tag, muts in req.messages.items():
             if muts:
                 w = mutations_weight(muts)
+                bytes_in += w
                 # weight rides with the entry: peeks and pops of the same
                 # batch must not re-walk every mutation
                 self.messages.setdefault(tag, deque()).append(
@@ -124,12 +145,22 @@ class TLog:
         # half-durable commit (lock-fence bypass, peeks serving non-durable
         # versions, concurrent DiskQueue mutation) — the atomicity of this
         # block is load-bearing for recovery correctness.
+        t0 = self.process.net.loop.now()
         seq = self.queue.push(wire.dumps((req.version, req.messages)))
         self.queue.commit()
         self._version_seq.append((req.version, seq))
         self.version.set(req.version)
         self._maybe_spill()
         reply.send(TLogCommitReply(version=req.version))
+        self._c_commits.increment()
+        self._c_bytes_in.increment(bytes_in)
+        # durable-write residency span (fsync runs on-loop by design; both
+        # records are emitted after the reply so a kill mid-commit cannot
+        # leave the span open)
+        g_trace_batch.span_begin("CommitSpan", f"v{req.version}",
+                                 "TLog.Commit", at=t0)
+        g_trace_batch.span_end("CommitSpan", f"v{req.version}",
+                               "TLog.Commit", at=self.process.net.loop.now())
 
     def _maybe_spill(self):
         """Evict the oldest in-memory entries once memory exceeds the spill
@@ -155,6 +186,7 @@ class TLog:
         # long-poll: block until there is something at/after `begin`
         # (reference peek waits for version growth, TLogServer.actor.cpp)
         from foundationdb_tpu.utils.knobs import KNOBS
+        self._c_peeks.increment()
         try:
             await self.version.when_at_least(req.begin)
         except FDBError as e:
@@ -216,6 +248,7 @@ class TLog:
             known_committed_version=self.known_committed_version))
 
     def _on_pop(self, req: TLogPopRequest, reply):
+        self._c_pops.increment()
         self.popped[req.tag] = max(self.popped.get(req.tag, 0), req.version)
         q = self.messages.get(req.tag)
         while q and q[0][0] < req.version:
@@ -293,6 +326,7 @@ class TLogHost:
         process.register(Token.TLOG_POP, self._route("_on_pop"))
         process.register(Token.TLOG_LOCK, self._route("_on_lock"))
         process.register(Token.QUEUE_STATS, self._on_queue_stats)
+        process.register(Token.TLOG_METRICS, self._on_metrics)
 
     def _on_queue_stats(self, req, reply):
         # un-popped bytes (memory + spilled), like the standalone handler: a
@@ -301,6 +335,21 @@ class TLogHost:
         reply.send(QueueStatsReply(queue_bytes=sum(
             sum(t._tag_bytes.values())
             for t in self.generations.values() if isinstance(t, TLog))))
+
+    def _on_metrics(self, req, reply):
+        """Sum counters across hosted generations (one worker = one row in
+        status, however many recoveries it has survived)."""
+        agg: dict = {"Generations": 0}
+        for t in self.generations.values():
+            if not isinstance(t, TLog):
+                continue
+            agg["Generations"] += 1
+            for k, v in t._metrics_snapshot().items():
+                if k == "DurableVersion":
+                    agg[k] = max(agg.get(k, 0), v)
+                else:
+                    agg[k] = agg.get(k, 0) + v
+        reply.send(agg)
 
     def add(self, uid: str, recovery_version: int = 0) -> TLog:
         """uids are unique per recovery ATTEMPT (LogSystemConfig's TLog UIDs),

@@ -1,0 +1,206 @@
+"""trace_analyze: reconstruct per-transaction commit timelines from spans.
+
+Reads the JSON-lines trace files the roles emit (TraceBatch span records,
+utils/trace.py) and answers "where does a commit spend its time": for every
+pipeline stage — client GRV, proxy batch assembly, commit-version fetch,
+resolve (kernel dispatch vs device readback wait), tlog push, reply — it
+pairs Begin/End records, stitches idents across roles through the
+CommitAttach records (client debug_id -> proxy batch -> commit version), and
+prints per-stage count / p50 / p99 residency.
+
+    python -m foundationdb_tpu.tools.trace_analyze trace*.jsonl
+    python -m foundationdb_tpu.tools.trace_analyze --json trace*.jsonl
+
+The same parsing doubles as the simulation tier's well-formedness check
+(`check_well_formed`): every Begin must have a matching End, and attaches
+must resolve to idents that actually appear in the stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(paths) -> list[dict]:
+    """All records from the given JSON-lines trace files, in file order.
+    Bad lines are skipped (a process killed mid-write leaves a torn tail)."""
+    events: list[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+    return events
+
+
+class _UnionFind:
+    """Ident stitching: CommitAttach(a -> b) means a and b name the same
+    transaction flow; the component representative groups every span that
+    belongs to one commit across client/proxy/resolver/tlog idents."""
+
+    def __init__(self):
+        self.parent: dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        self.parent.setdefault(x, x)
+        root = x
+        while self.parent[root] != root:
+            root = self.parent[root]
+        while self.parent[x] != root:  # path compression
+            self.parent[x], x = root, self.parent[x]
+        return root
+
+    def union(self, a: str, b: str):
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[ra] = rb
+
+
+def pair_spans(events) -> tuple[list[dict], list[dict]]:
+    """Match Begin/End records by (ID, Span), FIFO within a key (concurrent
+    same-stage spans on one ident nest in emission order). Returns
+    (completed spans with Start/End/Duration, unmatched records)."""
+    open_spans: dict[tuple[str, str], list[dict]] = {}
+    done: list[dict] = []
+    unmatched: list[dict] = []
+    for ev in events:
+        if "Span" not in ev or "Phase" not in ev:
+            continue
+        key = (str(ev.get("ID")), ev["Span"])
+        if ev["Phase"] == "Begin":
+            open_spans.setdefault(key, []).append(ev)
+        elif ev["Phase"] == "End":
+            stack = open_spans.get(key)
+            if not stack:
+                unmatched.append(ev)
+                continue
+            begin = stack.pop(0)
+            done.append({"ID": key[0], "Span": key[1],
+                         "Start": begin.get("Time", 0.0),
+                         "End": ev.get("Time", 0.0),
+                         "Duration": round(ev.get("Time", 0.0)
+                                           - begin.get("Time", 0.0), 6)})
+    for stack in open_spans.values():
+        unmatched.extend(stack)
+    return done, unmatched
+
+
+def stitch(events) -> _UnionFind:
+    uf = _UnionFind()
+    for ev in events:
+        if ev.get("Type") == "CommitAttach" and "To" in ev:
+            uf.union(str(ev.get("ID")), str(ev["To"]))
+    return uf
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def stage_stats(spans) -> dict:
+    """Per-stage residency: {span_name: {n, p50, p99, total}} seconds."""
+    by_stage: dict[str, list[float]] = {}
+    for s in spans:
+        by_stage.setdefault(s["Span"], []).append(s["Duration"])
+    out = {}
+    for stage, durs in sorted(by_stage.items()):
+        durs.sort()
+        out[stage] = {"n": len(durs),
+                      "p50": round(_percentile(durs, 0.50), 6),
+                      "p99": round(_percentile(durs, 0.99), 6),
+                      "total": round(sum(durs), 6)}
+    return out
+
+
+def transaction_timelines(events) -> dict[str, list[dict]]:
+    """Spans grouped by stitched transaction flow, each sorted by start
+    time — the per-commit waterfall."""
+    spans, _ = pair_spans(events)
+    uf = stitch(events)
+    flows: dict[str, list[dict]] = {}
+    for s in spans:
+        flows.setdefault(uf.find(s["ID"]), []).append(s)
+    for timeline in flows.values():
+        timeline.sort(key=lambda s: (s["Start"], s["Span"]))
+    return flows
+
+
+def check_well_formed(events) -> list[str]:
+    """Span-stream invariants; returns human-readable violations (empty ==
+    well formed). Used by the sim-tier smoke test."""
+    problems: list[str] = []
+    spans, unmatched = pair_spans(events)
+    for ev in unmatched:
+        problems.append(f"unbalanced span: {ev.get('Phase')} "
+                        f"{ev.get('Span')} id={ev.get('ID')}")
+    for s in spans:
+        if s["End"] < s["Start"]:
+            problems.append(f"span ends before it starts: {s['Span']} "
+                            f"id={s['ID']}")
+    ids_with_spans = {s["ID"] for s in spans}
+    for ev in events:
+        if ev.get("Type") != "CommitAttach" or "To" not in ev:
+            continue
+        # an attach whose BOTH ends name idents no span ever used is dead
+        # weight — something emitted bookkeeping for a flow that never ran
+        if (str(ev.get("ID")) not in ids_with_spans
+                and str(ev["To"]) not in ids_with_spans):
+            problems.append(f"dangling attach: {ev.get('ID')} -> {ev['To']}")
+    return problems
+
+
+def analyze(events) -> dict:
+    spans, unmatched = pair_spans(events)
+    flows = transaction_timelines(events)
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "unmatched": len(unmatched),
+        "flows": len(flows),
+        "stages": stage_stats(spans),
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [f"events={report['events']} spans={report['spans']} "
+             f"flows={report['flows']} unmatched={report['unmatched']}",
+             f"{'stage':<28} {'n':>7} {'p50 (s)':>10} {'p99 (s)':>10} "
+             f"{'total (s)':>10}"]
+    for stage, st in report["stages"].items():
+        lines.append(f"{stage:<28} {st['n']:>7} {st['p50']:>10.6f} "
+                     f"{st['p99']:>10.6f} {st['total']:>10.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_analyze",
+        description="per-stage commit latency from span trace files")
+    ap.add_argument("paths", nargs="+", help="JSON-lines trace files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+    events = load_events(args.paths)
+    report = analyze(events)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,10 +121,34 @@ def bound_device_discovery(timeout: float | None = None) -> str:
 # and hang on a wedged runtime with no deadline.
 # ---------------------------------------------------------------------------
 
+from foundationdb_tpu.utils.stats import CounterCollection
+
+# Process-wide transfer gauges, fed by the choke points below and merged
+# into the resolver's RESOLVER_METRICS snapshot. Counting here (rather
+# than at call sites) means no transfer can escape accounting without
+# also escaping the DEV007 discipline.
+transfer_metrics = CounterCollection("JaxTransfers")
+_put_count = transfer_metrics.counter("DevicePuts")
+_put_bytes = transfer_metrics.counter("DevicePutBytes")
+_get_count = transfer_metrics.counter("DeviceGets")
+_get_bytes = transfer_metrics.counter("DeviceGetBytes")
+
+
+def _nbytes(x) -> int:
+    try:
+        import jax
+        return sum(int(getattr(leaf, "nbytes", 0) or 0)
+                   for leaf in jax.tree_util.tree_leaves(x))
+    except Exception:  # noqa: BLE001 — accounting must never fail a transfer
+        return 0
+
+
 def device_put(x, sharding=None):
     """jax.device_put through the platform-honoring choke point."""
     ensure_platform_honored()
     import jax
+    _put_count.increment()
+    _put_bytes.increment(_nbytes(x))
     return jax.device_put(x, sharding) if sharding is not None \
         else jax.device_put(x)
 
@@ -133,4 +157,6 @@ def device_get(x):
     """jax.device_get through the platform-honoring choke point."""
     ensure_platform_honored()
     import jax
+    _get_count.increment()
+    _get_bytes.increment(_nbytes(x))
     return jax.device_get(x)

@@ -25,6 +25,10 @@ class Counter:
     def increment(self, n: int = 1):
         self.value += n
 
+    def set(self, v):
+        """Gauge-style assignment (last-sampled value, not monotonic)."""
+        self.value = v
+
     def rate_since_dump(self, dt: float) -> float:
         return (self.value - self._last_dumped) / dt if dt > 0 else 0.0
 

@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import sys
 import time
-from collections import defaultdict
 from typing import Callable
 
 SevDebug, SevInfo, SevWarn, SevWarnAlways, SevError = 5, 10, 20, 30, 40
@@ -68,21 +67,14 @@ class TraceEvent:
             print(json.dumps(self._fields, default=str), file=sys.stderr)
 
 
-class CounterCollection:
-    """Named monotonic counters per role (flow/Stats.h:57)."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self.counters: dict[str, float] = defaultdict(float)
-
-    def add(self, key: str, n: float = 1.0):
-        self.counters[key] += n
-
-    def trace(self):
-        ev = TraceEvent(f"{self.name}Metrics")
-        for k, v in sorted(self.counters.items()):
-            ev.detail(k, v)
-        ev.log()
+def __getattr__(name):
+    # Counter/CounterCollection/trace_counters_loop live in utils/stats.py
+    # (the canonical flow/Stats.h port); re-exported lazily because stats
+    # imports TraceEvent from this module.
+    if name in ("Counter", "CounterCollection", "trace_counters_loop"):
+        from foundationdb_tpu.utils import stats
+        return getattr(stats, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class RollingTraceFile:
@@ -188,16 +180,36 @@ class TraceBatch:
         self.max_buffer = max_buffer
         self._events: list[dict] = []
 
-    def add_event(self, kind: str, ident, location: str):
-        self._events.append({"Type": kind, "Time": round(_now(), 6),
+    def add_event(self, kind: str, ident, location: str, at: float | None = None):
+        self._events.append({"Type": kind,
+                             "Time": round(_now() if at is None else at, 6),
                              "ID": str(ident), "Location": location})
         if len(self._events) >= self.max_buffer:
             self.dump()
 
-    def add_attach(self, kind: str, ident, to: str):
+    def add_attach(self, kind: str, ident, to: str, at: float | None = None):
         """Link two ids (e.g. a transaction to its commit batch)."""
-        self._events.append({"Type": kind, "Time": round(_now(), 6),
+        self._events.append({"Type": kind,
+                             "Time": round(_now() if at is None else at, 6),
                              "ID": str(ident), "To": str(to)})
+        if len(self._events) >= self.max_buffer:
+            self.dump()
+
+    def span_begin(self, kind: str, ident, span: str, at: float | None = None):
+        """Begin a named stage span for one id. Pass `at=loop.now()` so sim
+        roles stamp virtual time (the global clock is per-interpreter and a
+        process never owns it)."""
+        self._span(kind, ident, span, "Begin", at)
+
+    def span_end(self, kind: str, ident, span: str, at: float | None = None):
+        self._span(kind, ident, span, "End", at)
+
+    def _span(self, kind: str, ident, span: str, phase: str, at: float | None):
+        self._events.append({"Type": kind,
+                             "Time": round(_now() if at is None else at, 6),
+                             "ID": str(ident), "Span": span, "Phase": phase})
+        if len(self._events) >= self.max_buffer:
+            self.dump()
 
     def dump(self):
         events, self._events = self._events, []

@@ -444,6 +444,55 @@ def test_flow006_quiet_for_lazy_eval_and_jit_decorators():
     assert findings == []
 
 
+# ---------------------------------------------------------------- FLOW007
+
+def test_flow007_flags_unlogged_trace_event_statement():
+    findings = lint("""
+        from foundationdb_tpu.utils.trace import TraceEvent
+
+        def report(addr, n):
+            TraceEvent("RoleMetrics", addr).detail("N", n)
+            TraceEvent("RoleUp", addr)
+    """)
+    assert [f.rule for f in findings] == ["FLOW007", "FLOW007"]
+
+
+def test_flow007_flags_assigned_event_never_logged():
+    findings = lint("""
+        from foundationdb_tpu.utils.trace import TraceEvent
+
+        def report(addr, rows):
+            ev = TraceEvent("RoleMetrics", addr)
+            for k, v in rows:
+                ev.detail(k, v)
+    """)
+    assert [f.rule for f in findings] == ["FLOW007"]
+    assert findings[0].detail == "ev"
+
+
+def test_flow007_quiet_for_logged_and_escaping_events():
+    findings = lint("""
+        from foundationdb_tpu.utils.trace import TraceEvent
+
+        def direct(addr):
+            TraceEvent("RoleMetrics", addr).detail("N", 1).log()
+
+        def accumulated(addr, rows):
+            ev = TraceEvent("RoleMetrics", addr)
+            for k, v in rows:
+                ev.detail(k, v)
+            ev.log()
+
+        def escapes(addr):
+            ev = TraceEvent("RoleMetrics", addr)
+            return ev  # the caller owns the .log() now
+
+        def unrelated(tr):
+            tr.set(b"k", b"v")  # fluent-looking but not a TraceEvent
+    """)
+    assert findings == []
+
+
 # ------------------------------------------------- ADVICE fix regressions
 
 def test_advice_fix_drain_gate_survives_partial_cancel():

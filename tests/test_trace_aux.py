@@ -38,6 +38,87 @@ def test_rolling_trace_file(tmp_path):
             json.loads(line)
 
 
+def test_rolling_trace_file_keep_chain(tmp_path):
+    """Explicit rolls shift path.1 -> path.2 -> ... and drop past `keep`;
+    the newest roll always holds the newest content."""
+    path = str(tmp_path / "trace.log")
+    rt = T.RollingTraceFile(path, roll_bytes=10**9, keep=2)
+    T.set_sink(rt.write)
+    for gen in range(4):
+        T.TraceEvent("Gen").detail("N", gen).log()
+        rt.roll()
+    rt.close()
+    names = sorted(f for f in os.listdir(tmp_path)
+                   if f.startswith("trace.log."))
+    assert names == ["trace.log.1", "trace.log.2"]  # 3rd+ oldest dropped
+    newest = [json.loads(line) for line in open(tmp_path / "trace.log.1")]
+    assert newest[-1]["N"] == 3
+    older = [json.loads(line) for line in open(tmp_path / "trace.log.2")]
+    assert older[-1]["N"] == 2
+
+
+def test_suppression_flush_on_quiet():
+    """A chatty type that goes quiet still surfaces its final window's
+    Dropped count via flush_suppressed()."""
+    got: list[dict] = []
+    T.set_sink(got.append)
+    T.enable_suppression(limit=3, interval=10_000.0)
+    for _ in range(10):
+        T.TraceEvent("Chatty").log()
+    assert not [e for e in got if e["Type"] == "TraceEventsSuppressed"]
+    T.flush_suppressed()
+    sup = [e for e in got if e["Type"] == "TraceEventsSuppressed"]
+    assert len(sup) == 1
+    assert sup[0]["OfType"] == "Chatty" and sup[0]["Dropped"] == 7
+    # flushed windows reset: a second flush reports nothing new
+    T.flush_suppressed()
+    assert len([e for e in got if e["Type"] == "TraceEventsSuppressed"]) == 1
+
+
+def test_sampling_profiler_catches_a_hot_loop():
+    import time as wall
+
+    from foundationdb_tpu.utils.profiler import SamplingProfiler
+
+    def hot_spin(deadline):
+        x = 0
+        while wall.perf_counter() < deadline:
+            x += 1
+        return x
+
+    p = SamplingProfiler(interval=0.001)
+    p.start()
+    hot_spin(wall.perf_counter() + 0.25)
+    report = p.stop()
+    assert p.total_samples > 0 and report
+    hottest = p.hottest_functions(top=5)
+    assert any("hot_spin" in label for label, _n in hottest), hottest
+    got: list[dict] = []
+    T.set_sink(got.append)
+    p.trace_report(who="test")
+    assert any(e["Type"] == "ProfilerSample" and "hot_spin" in e["Where"]
+               for e in got)
+
+
+def test_latency_bands_exact_edges():
+    """Band assignment at the boundaries: a sample exactly ON an upper
+    bound lands in that bound's band (bisect_left semantics)."""
+    lb = T.LatencyBands("Edges")
+    first, last = T.LatencyBands.BANDS[0], T.LatencyBands.BANDS[-1]
+    lb.add(0.0)          # below everything -> first band
+    lb.add(first)        # exactly the first bound -> still le_first
+    lb.add(last)         # exactly the last bound -> le_last, not gt
+    lb.add(last + 1e-9)  # just past it -> overflow bucket
+    got: list[dict] = []
+    T.set_sink(got.append)
+    lb.trace()
+    ev = got[0]
+    assert ev[f"le_{first}"] == 2
+    assert ev[f"le_{last}"] == 1
+    assert ev["gt_last"] == 1
+    assert ev["Total"] == 4 and ev["Max"] == round(last + 1e-9, 6)
+
+
 def test_suppression_limits_and_reports(tmp_path):
     got: list[dict] = []
     T.set_sink(got.append)

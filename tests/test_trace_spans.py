@@ -1,0 +1,250 @@
+"""Commit-path span tracing: record shape, cross-role stitching, stream
+well-formedness, and determinism under the simulator.
+
+Reference: flow/Trace.h g_traceBatch attach/event records
+(NativeAPI.actor.cpp debugTransaction, MasterProxyServer.actor.cpp
+commitBatch probes) extended here into Begin/End span pairs; the analyzer
+lives in tools/trace_analyze.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.tools import trace_analyze as TA
+from foundationdb_tpu.utils import trace as T
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    T.g_trace_batch._events.clear()
+    yield
+    T.set_sink(None)
+    T.disable_suppression()
+    T.g_trace_batch._events.clear()
+
+
+# ------------------------------------------------------------- primitives
+
+def test_span_record_shape_and_explicit_time():
+    tb = T.TraceBatch()
+    tb.span_begin("CommitSpan", "b0.1", "Proxy.Resolve", at=12.5)
+    tb.span_end("CommitSpan", "b0.1", "Proxy.Resolve", at=12.75)
+    begin, end = tb._events
+    assert begin == {"Type": "CommitSpan", "Time": 12.5, "ID": "b0.1",
+                     "Span": "Proxy.Resolve", "Phase": "Begin"}
+    assert end["Phase"] == "End" and end["Time"] == 12.75
+
+
+def test_span_buffer_auto_dumps_at_capacity():
+    got: list[dict] = []
+    T.set_sink(got.append)
+    tb = T.TraceBatch(max_buffer=4)
+    for i in range(4):
+        tb.span_begin("CommitSpan", f"x{i}", "Stage")
+    assert tb._events == [] and len(got) == 4
+
+
+# --------------------------------------------------------- trace_analyze
+
+def _mk(ident, span, t0, t1):
+    return [{"Type": "CommitSpan", "Time": t0, "ID": ident, "Span": span,
+             "Phase": "Begin"},
+            {"Type": "CommitSpan", "Time": t1, "ID": ident, "Span": span,
+             "Phase": "End"}]
+
+
+def test_analyze_pairs_stitches_and_ranks():
+    events = (_mk("c1", "Client.Commit", 0.0, 0.05)
+              + [{"Type": "CommitAttach", "Time": 0.01, "ID": "c1",
+                  "To": "b0.7"}]
+              + _mk("b0.7", "Proxy.Resolve", 0.01, 0.02)
+              + [{"Type": "CommitAttach", "Time": 0.02, "ID": "b0.7",
+                  "To": "v900"}]
+              + _mk("v900", "TLog.Commit", 0.02, 0.04)
+              + _mk("c2", "Client.Commit", 0.0, 0.01))
+    rep = TA.analyze(events)
+    assert rep["spans"] == 4 and rep["unmatched"] == 0
+    # c1/b0.7/v900 collapse into one flow; c2 stands alone
+    assert rep["flows"] == 2
+    flows = TA.transaction_timelines(events)
+    big = max(flows.values(), key=len)
+    assert [s["Span"] for s in big] == ["Client.Commit", "Proxy.Resolve",
+                                       "TLog.Commit"]
+    st = rep["stages"]["Client.Commit"]
+    assert st["n"] == 2 and st["p50"] == 0.01 and st["p99"] == 0.05
+
+
+def test_analyze_fifo_pairing_for_concurrent_same_stage_spans():
+    # two overlapping spans on ONE (id, stage) pair match in emission order
+    events = [
+        {"Type": "CommitSpan", "Time": 0.0, "ID": "v1",
+         "Span": "Resolver.ReadbackWait", "Phase": "Begin"},
+        {"Type": "CommitSpan", "Time": 0.1, "ID": "v1",
+         "Span": "Resolver.ReadbackWait", "Phase": "Begin"},
+        {"Type": "CommitSpan", "Time": 0.2, "ID": "v1",
+         "Span": "Resolver.ReadbackWait", "Phase": "End"},
+        {"Type": "CommitSpan", "Time": 0.4, "ID": "v1",
+         "Span": "Resolver.ReadbackWait", "Phase": "End"},
+    ]
+    spans, unmatched = TA.pair_spans(events)
+    assert not unmatched
+    assert sorted(round(s["Duration"], 6) for s in spans) == [0.2, 0.3]
+
+
+def test_check_well_formed_catches_violations():
+    good = _mk("a", "S", 0.0, 1.0)
+    assert TA.check_well_formed(good) == []
+    assert TA.check_well_formed(good[:1])  # dangling Begin
+    assert TA.check_well_formed(good[1:])  # End without Begin
+    backwards = _mk("b", "S", 5.0, 1.0)
+    assert any("ends before" in p for p in TA.check_well_formed(backwards))
+    dangling = good + [{"Type": "CommitAttach", "Time": 0.0, "ID": "ghost1",
+                        "To": "ghost2"}]
+    assert any("dangling attach" in p for p in TA.check_well_formed(dangling))
+
+
+def test_load_events_skips_torn_lines(tmp_path):
+    p = tmp_path / "trace.jsonl"
+    p.write_text('{"Type": "CommitSpan", "ID": "x"}\n'
+                 "\n"
+                 '{"Type": "Commit')  # torn tail from a killed process
+    events = TA.load_events([str(p)])
+    assert len(events) == 1 and events[0]["ID"] == "x"
+
+
+# ------------------------------------------------- simulated commit path
+
+EXPECTED_STAGES = {
+    "Client.GRV", "Client.Commit", "Proxy.BatchAssembly",
+    "Proxy.GetCommitVersion", "Proxy.Resolve", "Proxy.TLogPush",
+    "Proxy.Reply", "Resolver.Dispatch", "TLog.Commit",
+}
+
+
+def _run_workload(seed: int) -> list[dict]:
+    """A small commit workload on a fresh SimCluster with a capture sink;
+    returns every record that reached the sink."""
+    from foundationdb_tpu.server.cluster import SimCluster
+    from foundationdb_tpu.utils.knobs import KNOBS
+
+    got: list[dict] = []
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    try:
+        T.set_sink(got.append)
+        T.enable_suppression()  # prod-shaped config: spans must fit under it
+        c = SimCluster(seed=seed, n_proxies=2, n_resolvers=1, n_tlogs=1,
+                       n_storage=1)
+        db = c.database()
+
+        async def client(cid: int):
+            for i in range(6):
+                tr = db.create_transaction()
+                await tr.get(b"s%d.%d" % (cid, i))
+                tr.set(b"s%d.%d" % (cid, i), b"v")
+                await tr.commit()
+        c.run_all([client(i) for i in range(3)], max_time=600.0)
+        T.g_trace_batch.dump()
+        T.flush_suppressed()
+    finally:
+        T.set_sink(None)
+        T.disable_suppression()
+        KNOBS.reset()
+    return got
+
+
+def test_sim_span_stream_well_formed_and_stitched():
+    got = _run_workload(seed=11)
+    # every stage of the pipeline shows up
+    seen_stages = {e["Span"] for e in got if "Span" in e}
+    assert EXPECTED_STAGES <= seen_stages, seen_stages
+    # stream invariants: every Begin has an End, no attach is dead weight
+    assert TA.check_well_formed(got) == []
+    # cross-role stitching: each client commit id reaches a version ident
+    # (client -> proxy batch -> commit version) through the attach records
+    uf = TA.stitch(got)
+    commit_ids = {e["ID"] for e in got
+                  if e.get("Span") == "Client.Commit" and e["ID"].startswith("c")}
+    version_ids = {e["ID"] for e in got
+                   if e.get("Span") == "TLog.Commit"}
+    assert commit_ids and version_ids
+    version_roots = {uf.find(v) for v in version_ids}
+    stitched = [cid for cid in commit_ids if uf.find(cid) in version_roots]
+    assert stitched, "no client commit id stitched through to a version"
+    # spans rode under the suppression threshold: nothing was dropped
+    assert not [e for e in got if e["Type"] == "TraceEventsSuppressed"]
+    # analyzer end-to-end over the sim stream
+    rep = TA.analyze(got)
+    assert rep["unmatched"] == 0
+    for stage in EXPECTED_STAGES:
+        assert rep["stages"][stage]["n"] >= 1
+
+
+def test_sim_span_stream_deterministic():
+    """Same seed => same span/attach/probe sequence modulo wall-clock
+    fields (span Times are virtual and must match exactly too; counter
+    TraceEvents carry wall time and are excluded)."""
+    def batch_records(events):
+        return [{k: v for k, v in e.items()}
+                for e in events
+                if e.get("Type") in ("CommitSpan", "CommitAttach",
+                                     "CommitDebug")]
+    a = batch_records(_run_workload(seed=23))
+    b = batch_records(_run_workload(seed=23))
+    assert a == b
+    c = batch_records(_run_workload(seed=24))
+    assert [e.get("Span") for e in a] != [e.get("Span") for e in c] or a != c
+
+
+# ------------------------------------------------------ cluster-wide status
+
+def test_status_carries_all_six_role_counters():
+    """The CC's status JSON aggregates a counter snapshot from every role
+    kind (master, proxy, resolver, log, storage, ratekeeper) plus the
+    cluster-wide workload rollup."""
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    from foundationdb_tpu.utils.knobs import KNOBS
+
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    try:
+        c = RecoverableCluster(seed=5)
+        db = c.database()
+
+        async def work():
+            await db.refresh(max_wait=300.0)
+            for i in range(8):
+                async def fn(tr, i=i):
+                    await tr.get(b"st%d" % i)
+                    tr.set(b"st%d" % i, b"v")
+                await db.transact(fn, max_retries=50)
+            return await db.get_status()
+        status = c.run(c.loop.spawn(work()), max_time=60_000.0)
+    finally:
+        KNOBS.reset()
+
+    roles = status["cluster"]["roles"]
+    by_kind: dict[str, list[dict]] = {}
+    for entry in roles:
+        by_kind.setdefault(entry["role"], []).append(entry)
+    for kind in ("master", "proxy", "resolver", "log", "storage",
+                 "ratekeeper", "cluster_controller"):
+        assert kind in by_kind, f"missing {kind}: {sorted(by_kind)}"
+        assert any("counters" in e for e in by_kind[kind]), kind
+    # the snapshots reflect the traffic that just ran
+    master = next(e["counters"] for e in by_kind["master"] if "counters" in e)
+    assert master["VersionRequests"] >= 8
+    resolver = next(e["counters"] for e in by_kind["resolver"]
+                    if "counters" in e)
+    assert resolver["TxnResolved"] >= 8
+    assert resolver["Backend"] == "oracle"
+    log = next(e["counters"] for e in by_kind["log"] if "counters" in e)
+    assert log["Commits"] >= 8 and log["BytesIn"] > 0
+    storage_total = sum(e["counters"]["MutationsApplied"]
+                       for e in by_kind["storage"] if "counters" in e)
+    assert storage_total >= 8
+    rk = next(e["counters"] for e in by_kind["ratekeeper"] if "counters" in e)
+    assert rk["TPS"] > 0
+    workload = status["cluster"]["workload"]
+    assert workload["transactions_committed"] >= 8
+    assert workload["mutation_bytes"] > 0
+    assert workload["commit_batches"] >= 1
