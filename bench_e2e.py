@@ -30,6 +30,7 @@ via bench.py which folds the numbers into its one-line output.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import socket
@@ -44,6 +45,21 @@ KEYS = 2000
 # core, so per-op formatting would tax the system under test
 _KEYTAB = [b"k%06d" % i for i in range(KEYS)]
 _SELF = os.path.abspath(__file__)
+
+# the mixed-contended phase concentrates writes on a zipfian-hot prefix of
+# the keytab (background reads stay off it, so every conflict is a hot-range
+# write-write collision the throttle loop can act on)
+HOT_KEYS = 64
+_zw = [1.0 / float(i + 1) ** 1.2 for i in range(HOT_KEYS)]
+_ZIPF_CDF = []
+_acc = 0.0
+for _w in _zw:
+    _acc += _w
+    _ZIPF_CDF.append(_acc / sum(_zw))
+
+
+def _zipf_idx(r: float) -> int:
+    return min(HOT_KEYS - 1, bisect.bisect_left(_ZIPF_CDF, r))
 
 
 def _free_port() -> int:
@@ -62,10 +78,11 @@ def _spawn_server(spec: dict, env: dict) -> subprocess.Popen:
 
 
 def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
-                  trace_dir=None):
+                  trace_dir=None, extra_knobs=None):
     from foundationdb_tpu.server.interfaces import Token
 
     txn_knobs = {"CONFLICT_BACKEND": backend}
+    txn_knobs.update(extra_knobs or {})
     # A forced-CPU device run serves with the exact host evaluator
     # (CONFLICT_CPU_FALLBACK default "host"): XLA-on-CPU costs ~10-20x the
     # host skiplist per txn, and on one core the engine and the rest of the
@@ -130,6 +147,7 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
                                          "token": Token.RESOLVER_RESOLVE}]},
             "tlogs": [{"address": p_core, "token": Token.TLOG_COMMIT}],
             "shards": shard_spec,
+            "ratekeeper": p_core,
         }}
 
     core_spec = {
@@ -140,6 +158,13 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
             {"role": "master", "args": {}},
             {"role": "resolver", "args": {"n_proxies": max(n_proxies, 1)}},
             {"role": "tlog", "args": {}},
+            # admission control lives with the txn subsystem: the RK samples
+            # the co-located tlog/resolver plus every storage process, and
+            # the proxies fetch their budget (and the hot-range throttle
+            # list) from it over the same transport
+            {"role": "ratekeeper", "args": {"tlogs": [p_core],
+                                            "storages": p_storages,
+                                            "resolvers": [p_core]}},
         ] + ([proxy_role(0, p_core)] if merged else []),
     }
     proxy_specs = []
@@ -148,7 +173,7 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
             proxy_specs.append({
                 "listen": addr,
                 "data_dir": os.path.join(tmp, f"proxy{i}"),
-                "knobs": batch_knobs,
+                "knobs": dict(batch_knobs, **(extra_knobs or {})),
                 "roles": [proxy_role(i, addr)],
             })
     storage_specs = []
@@ -230,6 +255,7 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
     run-to-run variance."""
     stop_at = time.perf_counter() + seconds + ramp
     ops = [0]
+    txns = [0]
     grv_lat: list[float] = []
     commit_lat: list[float] = []
     # failed attempts by kind (FDBError name / exception class): swallowed
@@ -240,6 +266,7 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
     async def ramp_reset():
         await loop.delay(ramp)
         ops[0] = 0
+        txns[0] = 0
         grv_lat.clear()
         commit_lat.clear()
         errors.clear()
@@ -251,6 +278,7 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
         # multiply beat rng.randrange by ~2x at this call frequency)
         rnd = random.Random(cid).random
         writing, mixed = kind == "write", kind == "mixed"
+        contended = kind == "mixed-contended"
         wval = b"w" * 16
         keytab = _KEYTAB
         while time.perf_counter() < stop_at:
@@ -262,33 +290,70 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
                 n = 10
                 wrote = False
                 reads = []
-                for i in range(n):
-                    if writing or (mixed and rnd() < 0.1):
-                        tr.set(keytab[int(rnd() * KEYS)], wval)
-                        wrote = True
-                    else:
-                        reads.append(keytab[int(rnd() * KEYS)])
-                if reads:
-                    # issue a txn's reads concurrently as one multiget —
-                    # same per-key semantics (conflict keys, RYW) as N
-                    # get_future calls, one future per txn
+                hot = None
+                if contended and rnd() < 0.45:
+                    # informed retry: a key under a server-advised penalty
+                    # (a transaction_throttled rejection seeded the shared
+                    # cache) gets redrawn — load steers toward the colder
+                    # part of the hot range instead of hammering the peak.
+                    # All draws penalized -> divert to background reads.
+                    for _ in range(4):
+                        k = keytab[_zipf_idx(rnd())]
+                        if db._penalty_wait([(k, k + b"\x00")]) <= 0.0:
+                            hot = k
+                            break
+                if hot is not None:
+                    # hot transaction: read-modify-write of ONE zipfian-hot
+                    # key (read first, so a concurrently landed write aborts
+                    # this txn with not_committed). Kept separate from the
+                    # read transactions below so hot-range contention stalls
+                    # only hot work, not background reads.
+                    await tr.get(hot)
+                    tr.set(hot, wval)
+                    wrote = True
+                    n = 2
+                elif contended:
+                    # background reads stay OFF the hot prefix: every
+                    # conflict in this phase is a hot-range write-write
+                    # collision the throttle loop can act on
+                    reads = [keytab[HOT_KEYS + int(rnd() * (KEYS - HOT_KEYS))]
+                             for _ in range(n)]
                     await tr.get_many(reads)
+                else:
+                    for i in range(n):
+                        if writing or (mixed and rnd() < 0.1):
+                            tr.set(keytab[int(rnd() * KEYS)], wval)
+                            wrote = True
+                        else:
+                            reads.append(keytab[int(rnd() * KEYS)])
+                    if reads:
+                        # issue a txn's reads concurrently as one multiget —
+                        # same per-key semantics (conflict keys, RYW) as N
+                        # get_future calls, one future per txn
+                        await tr.get_many(reads)
                 if wrote:
                     t1 = time.perf_counter()
                     await tr.commit()
                     commit_lat.append(time.perf_counter() - t1)
                 ops[0] += n
+                txns[0] += 1
             except Exception as e:  # noqa: BLE001
                 # retries are the app's concern; keep pumping — but COUNT
                 # what was dropped so the report carries an error rate
                 name = getattr(e, "name", None) or type(e).__name__
                 errors[name] = errors.get(name, 0) + 1
+                if name == "transaction_throttled":
+                    # informed backoff: seed the shared per-range penalty
+                    # cache — later iterations see the penalty at draw time
+                    # and divert to read work, so the client stays busy
+                    # instead of sleeping out the advised delay
+                    db._note_throttle(e)
 
     tasks = [loop.spawn(one_client(c), name=f"bench{c}")
              for c in range(clients)] + [loop.spawn(ramp_reset(), name="ramp")]
     for t in tasks:
         await t
-    return ops[0], grv_lat, commit_lat, errors
+    return ops[0], txns[0], grv_lat, commit_lat, errors
 
 
 def _pcts(lat: list[float]) -> dict:
@@ -325,16 +390,16 @@ def worker_main(spec: dict):
         return await _run_phase(loop, db, spec["kind"], spec["clients"],
                                 spec["seconds"])
 
-    ops, grv, com, errors = loop.run_future(loop.spawn(main()),
-                                            max_time=60.0 + spec["seconds"])
+    ops, txns, grv, com, errors = loop.run_future(
+        loop.spawn(main()), max_time=60.0 + spec["seconds"])
     client.close()
     if trace_file is not None:
         from foundationdb_tpu.utils.trace import g_trace_batch, set_sink
         g_trace_batch.dump()
         set_sink(None)
         trace_file.close()
-    print(json.dumps({"ops": ops, "grv": _pcts(grv), "commit": _pcts(com),
-                      "errors": errors}),
+    print(json.dumps({"ops": ops, "txns": txns, "grv": _pcts(grv),
+                      "commit": _pcts(com), "errors": errors}),
           flush=True)
 
 
@@ -360,13 +425,15 @@ def _stage_breakdown(trace_dir: str) -> dict | None:
     rep = trace_analyze.analyze(trace_analyze.load_events(paths))
     return {"files": len(paths), "flows": rep["flows"],
             "spans": rep["spans"], "unmatched": rep["unmatched"],
-            "stages": rep["stages"]}
+            "stages": rep["stages"], "contention": rep["contention"]}
 
 
 def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         n_proxies: int = 0, n_storage: int = 1,
-        n_client_procs: int = 2, trace: bool = False) -> dict:
-    """One pass per phase (write, read, 90/10); returns the report dict."""
+        n_client_procs: int = 2, trace: bool = False,
+        phases: tuple = ("write", "read", "mixed"),
+        extra_knobs: dict | None = None) -> dict:
+    """One pass per phase; returns the report dict."""
     from foundationdb_tpu.net.transport import RealEventLoop
 
     tmp = tempfile.mkdtemp(prefix="fdbtpu-bench-")
@@ -375,7 +442,8 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         trace_dir = os.path.join(tmp, "traces")
         os.makedirs(trace_dir, exist_ok=True)
     procs, p_proxies, boundaries, p_storages = _boot_cluster(
-        tmp, backend, n_proxies, n_storage, trace_dir=trace_dir)
+        tmp, backend, n_proxies, n_storage, trace_dir=trace_dir,
+        extra_knobs=extra_knobs)
     report: dict = {"clients": clients, "conflict_backend": backend,
                     "topology": {"proxies": n_proxies, "storage": n_storage,
                                  "client_procs": n_client_procs}}
@@ -420,7 +488,7 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
 
         per = [clients // n_client_procs] * n_client_procs
         per[0] += clients - sum(per)
-        for kind in ("write", "read", "mixed"):
+        for kind in phases:
             workers = []
             for k in range(n_client_procs):
                 spec = {"kind": kind, "clients": per[k],
@@ -442,18 +510,25 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
                 results.append(json.loads(line))
                 w.wait(timeout=60)
             rate = sum(r["ops"] for r in results) / seconds
-            entry = {"ops_per_sec": round(rate, 1),
-                     "vs_baseline": round(rate / BASELINES[kind], 3)}
+            entry = {"ops_per_sec": round(rate, 1)}
+            if kind in BASELINES:
+                entry["vs_baseline"] = round(rate / BASELINES[kind], 3)
             errs: dict[str, int] = {}
             for r in results:
                 for name, cnt in r.get("errors", {}).items():
                     errs[name] = errs.get(name, 0) + cnt
-            # each successful txn contributed exactly 10 ops (see one_client)
-            succ_txns = sum(r["ops"] for r in results) // 10
+            succ_txns = sum(r["txns"] for r in results)
             total_errs = sum(errs.values())
             entry["errors"] = errs
             entry["error_rate"] = round(
                 total_errs / max(1, succ_txns + total_errs), 4)
+            # the contention acceptance metric is the NOT_COMMITTED share
+            # specifically: throttle rejections are retryable-with-advice,
+            # conflicts are wasted pipeline work
+            entry["not_committed_rate"] = round(
+                errs.get("not_committed", 0)
+                / max(1, succ_txns + total_errs), 4)
+            entry["committed_txns_per_sec"] = round(succ_txns / seconds, 1)
             grv = _merge_pcts([r["grv"] for r in results])
             com = _merge_pcts([r["commit"] for r in results])
             if grv:
@@ -476,9 +551,33 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
     return report
 
 
+def run_contended_pair(backend: str = "oracle", clients: int = 1500,
+                       seconds: float = 5.0) -> dict:
+    """The contention-management row pair: the zipfian mixed-contended
+    phase with the throttle loop ON vs OFF on otherwise identical
+    topologies. The claim under test: throttling-on cuts the not_committed
+    rate without cutting committed-txn throughput."""
+    # identical on both rows (only the enable flag differs): wide hot-range
+    # snapshots so steering can't just push load onto untracked keys, and
+    # per-range admission ~1/commit-RTT so admitted RMWs rarely overlap
+    base = {"HOTSPOT_TOP_K": 32, "RK_THROTTLE_CONFLICT_RATE": 10.0,
+            "RK_THROTTLE_RELEASE_TPS": 10.0}
+    out = {}
+    for label, extra in (
+            ("throttle_on", {}),
+            ("throttle_off", {"CONTENTION_THROTTLE_ENABLED": False})):
+        out[label] = run(clients=clients, seconds=seconds, backend=backend,
+                         phases=("mixed-contended",),
+                         extra_knobs=dict(base, **extra), trace=True)
+    return out
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(json.loads(sys.argv[2]))
+        sys.exit(0)
+    if "--contended" in sys.argv:
+        print(json.dumps(run_contended_pair(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

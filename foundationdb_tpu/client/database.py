@@ -168,6 +168,44 @@ class Database:
         # per database, address-prefixed so traces from many client processes
         # merge without collisions
         self._span_seq = 0
+        # informed-retry penalty cache (docs/contention.md): throttled range
+        # -> sim time the server-advised penalty expires. Shared across all
+        # this database's transactions, so one throttled commit teaches
+        # every subsequent retry touching that range to wait it out.
+        self._range_penalties: dict[tuple[bytes, bytes], float] = {}
+
+    def _note_throttle(self, error) -> float:
+        """Record a transaction_throttled error's advised backoff in the
+        penalty cache. detail is "<backoff> <begin_hex> <end_hex>" (set at
+        the proxy, utils/errors.py); returns the advised seconds."""
+        try:
+            parts = error.detail.split()
+            backoff = float(parts[0])
+            begin = bytes.fromhex(parts[1])
+            end = bytes.fromhex(parts[2])
+        except (ValueError, IndexError):
+            return KNOBS.DEFAULT_BACKOFF  # malformed detail: jitter only
+        expiry = self.loop.now() + backoff
+        key = (begin, end)
+        if self._range_penalties.get(key, 0.0) < expiry:
+            self._range_penalties[key] = expiry
+        return backoff
+
+    def _penalty_wait(self, write_ranges) -> float:
+        """Remaining advised penalty (seconds) over `write_ranges`, pruning
+        expired cache entries as a side effect."""
+        if not self._range_penalties:
+            return 0.0
+        now = self.loop.now()
+        for k in [k for k, t in self._range_penalties.items() if t <= now]:
+            del self._range_penalties[k]
+        wait = 0.0
+        for (pb, pe), expiry in self._range_penalties.items():
+            for b, e in write_ranges:
+                if b < pe and pb < e:
+                    wait = max(wait, expiry - now)
+                    break
+        return wait
 
     def _next_span_id(self, kind: str) -> str:
         self._span_seq += 1

@@ -385,19 +385,36 @@ class Transaction:
         return self._committed_version
 
     async def on_error(self, error: FDBError):
-        """The retry contract (NativeAPI Transaction::onError :2180): backoff
-        then reset, re-raise if not retryable (or past retry_limit)."""
+        """The retry contract (NativeAPI Transaction::onError :2180), with
+        two upgrades over blind doubling (docs/contention.md):
+
+        - decorrelated jitter: each sleep is drawn uniformly from
+          [DEFAULT_BACKOFF, 3 * previous_sleep], capped at MAX_BACKOFF —
+          retries desynchronize instead of stampeding in doubling cohorts.
+        - informed backoff: a transaction_throttled error carries the
+          server-advised wait and the throttled range; both feed the
+          database's per-range penalty cache, and the sleep honors the
+          LONGER of jitter, advice, and any live penalty on this
+          transaction's write set.
+        """
         if not isinstance(error, FDBError) or not error.is_retryable:
             raise error
         self._retries += 1
         if (self._opt_retry_limit is not None
                 and self._retries > self._opt_retry_limit):
             raise error
-        backoff = self._backoff
-        await self.db.loop.delay(backoff * (0.5 + self.db._rng.random()))
-        new_backoff = min(backoff * 2, KNOBS.MAX_BACKOFF)
+        base = KNOBS.DEFAULT_BACKOFF
+        hi = max(base, self._backoff * 3)
+        delay = min(KNOBS.MAX_BACKOFF,
+                    base + self.db._rng.random() * (hi - base))
+        advised = (self.db._note_throttle(error)
+                   if error.name == "transaction_throttled" else 0.0)
+        write_ranges = self._writes.write_conflict_ranges() \
+            + getattr(self, "_extra_write_conflicts", [])
+        wait = max(delay, advised, self.db._penalty_wait(write_ranges))
+        await self.db.loop.delay(wait)
         self.reset()
-        self._backoff = new_backoff
+        self._backoff = delay
 
     # -- limits (fdbclient/Knobs.cpp size limits) --
 

@@ -184,6 +184,16 @@ static PyObject *py_encode_keys_into(PyObject *self, PyObject *args) {
 #define W_MAX_DEPTH 64
 #define W_MAX_CONTAINER (1 << 24)
 
+/* Contention-management wire structs this codec round-trips through the
+ * generic registered-dataclass path (no dedicated emitter yet). Kept as
+ * schema comments so protolint's PROTO005 parity gate pins the field
+ * lists against the Python dataclasses:
+ *   HotRange { begin: key, end: key, rate: float }
+ *   HotRangesReply { ranges: [HotRange], total_rate: float }
+ *   ThrottleEntry { begin: key, end: key, release_tps: float, backoff: float }
+ *   RateInfoReply { tps: float, throttles: [ThrottleEntry] }
+ */
+
 /* registry: by_id[int] = (cls, names_tuple_or_None); by_type[type] = id */
 static PyObject *g_by_id = NULL;
 static PyObject *g_by_type = NULL;

@@ -52,6 +52,9 @@ def build_role(process, role: str, args: dict):
     if role == "storage":
         from foundationdb_tpu.server.storage import StorageServer
         return StorageServer(process, **args)
+    if role == "ratekeeper":
+        from foundationdb_tpu.server.ratekeeper import Ratekeeper
+        return Ratekeeper(process, **args)
     raise ValueError(f"unknown role {role!r}")
 
 

@@ -41,6 +41,19 @@ _CONNECT = b"fdbtpu" + bytes([PROTOCOL_VERSION])
 _REQUEST, _REPLY, _REPLY_ERROR, _ONE_WAY = 0, 1, 2, 3
 
 
+def _decode_wire_error(payload) -> FDBError:
+    """A _REPLY_ERROR body is either a bare error name (the common case) or
+    [name, detail] when the error carries advice the client must see (e.g.
+    transaction_throttled's backoff + hot range). Tolerate both shapes from
+    any peer version; anything else maps to unknown_error."""
+    if isinstance(payload, str):
+        return FDBError(payload)
+    if (isinstance(payload, (list, tuple)) and len(payload) == 2
+            and isinstance(payload[0], str) and isinstance(payload[1], str)):
+        return FDBError(payload[0], payload[1])
+    return FDBError("unknown_error")
+
+
 class _WireReplyPromise(Promise):
     """Reply promise for a remote request: the result goes straight to
     wire.dumps, so handlers may send a wire.PreEncoded frame. Class
@@ -506,8 +519,12 @@ class NetTransport:
             def on_reply(f: Future):
                 try:
                     if f.is_error():
-                        body = wire.dumps(getattr(f._result, "name",
-                                                    "unknown_error"))
+                        name = getattr(f._result, "name", "unknown_error")
+                        detail = getattr(f._result, "detail", "")
+                        # detail must survive the wire: transaction_throttled
+                        # carries the advised backoff + hot range in it, and
+                        # a client that loses it falls back to blind jitter
+                        body = wire.dumps([name, detail] if detail else name)
                         writer.write(self._frame(0, reply_id, _REPLY_ERROR, body))
                     else:
                         try:
@@ -537,9 +554,7 @@ class NetTransport:
                 if kind == _REPLY:
                     entry[0].send(payload)
                 elif kind == _REPLY_ERROR:
-                    entry[0].send_error(
-                        FDBError(payload) if isinstance(payload, str)
-                        else FDBError("unknown_error"))
+                    entry[0].send_error(_decode_wire_error(payload))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             # fail every in-flight request on this connection NOW (the peer-
             # failure path of FlowTransport): waiting out the RPC timeout

@@ -660,7 +660,8 @@ class ClusterController:
         rk_addr = (await self._recruit_many(
             stateless, 1, "ratekeeper",
             lambda i: {"tlogs": list(tlog_addrs),
-                       "storages": [a for a, _t in storages]}))[0]
+                       "storages": [a for a, _t in storages],
+                       "resolvers": list(resolver_addrs)}))[0]
 
         from foundationdb_tpu.server import systemdata
         from foundationdb_tpu.server.proxy import ResolverMap
@@ -976,6 +977,16 @@ class ClusterController:
         b = list(info.shard_boundaries)
         teams = [list(t) for t in info.teams()]
         addr_of_tag = {t: a for a, t in info.storages}
+        # conflict-hotspot feed (docs/contention.md): the resolver sketch
+        # gives DD a second split trigger — sustained write contention on a
+        # shard splits it even when its byte count is small
+        from foundationdb_tpu.server.hotspot import overlaps
+        hot_ranges = await self._poll_hot_ranges(info)
+        streaks = getattr(self, "_hot_streaks", None)
+        if streaks is None:
+            streaks = self._hot_streaks = {}
+        hot_shards: set[bytes] = set()  # shard begin keys hot THIS round
+        hot_split: tuple[int, bytes] | None = None
         # sample every shard from one replica
         sizes: list[int] = []
         for i, team in enumerate(teams):
@@ -987,17 +998,59 @@ class ClusterController:
                 GetStorageMetricsRequest(ranges=[(lo, hi)])), 2.0)
             m = metrics[0]
             sizes.append(m.bytes)
+            rate = sum(hr.rate for hr in hot_ranges
+                       if overlaps(hr.begin, hr.end, lo, hi))
+            if rate >= KNOBS.DD_SHARD_SPLIT_CONFLICT_RATE:
+                hot_shards.add(lo)
+                streaks[lo] = streaks.get(lo, 0) + 1
+                if (hot_split is None and m.split_key is not None
+                        and streaks[lo] >= KNOBS.DD_HOT_SHARD_ROUNDS):
+                    hot_split = (i, m.split_key)
+            else:
+                streaks.pop(lo, None)
             if m.bytes <= KNOBS.DD_SHARD_SPLIT_BYTES or m.split_key is None:
                 continue
             await self._split_and_move(i, m.split_key)
             return  # one relocation per round
+        if hot_split is not None:
+            i, split_key = hot_split
+            streaks.pop(b[i], None)  # the streak acted; children start fresh
+            TraceEvent("DDConflictSplit", self.process.address) \
+                .detail("Shard", b[i].hex()) \
+                .detail("SplitKey", split_key.hex()).log()
+            await self._split_and_move(i, split_key)
+            return
         # shardMerger (:379): two adjacent small shards on the SAME team
-        # collapse back into one — metadata-only (no data moves)
+        # collapse back into one — metadata-only (no data moves). Skip pairs
+        # touching a currently-hot shard: re-merging what the conflict
+        # trigger just split would make the two triggers fight forever.
         for i in range(len(teams) - 1):
+            if b[i] in hot_shards or b[i + 1] in hot_shards:
+                continue
             if (teams[i] == teams[i + 1]
                     and sizes[i] + sizes[i + 1] < KNOBS.DD_SHARD_MERGE_BYTES):
                 await self._merge(i)
                 return
+
+    async def _poll_hot_ranges(self, info) -> list:
+        """Merged conflict-hotspot snapshot across the live resolvers (the
+        DD side of the contention loop; ratekeeper polls independently for
+        throttling). A dead resolver costs one bounded timeout and is
+        skipped — DD must keep distributing through resolver failures."""
+        if not KNOBS.CONTENTION_THROTTLE_ENABLED or not info.resolvers:
+            return []
+        out = []
+        for a in info.resolvers:
+            try:
+                r = await self.loop.timeout(self.net.request(
+                    self.process, Endpoint(a, Token.RESOLVER_HOT_RANGES),
+                    KNOBS.HOTSPOT_TOP_K), 1.0)
+            except FDBError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                continue
+            out.extend(r.ranges)
+        return out
 
     async def _write_initial_metadata(self, snapshot):
         """Persist the recovery's \\xff snapshot through the pipeline

@@ -32,6 +32,7 @@ class Token:
     PROXY_GET_COMMITTED_VERSION = 13
     PROXY_PING = 14
     RESOLVER_RESOLVE = 20
+    RESOLVER_HOT_RANGES = 22  # conflict-hotspot snapshot (ratekeeper/DD poll)
     TLOG_COMMIT = 30
     TLOG_PEEK = 31
     TLOG_POP = 32

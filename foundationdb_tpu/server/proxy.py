@@ -191,6 +191,7 @@ class Proxy:
         self._c_conflicts = self.counters.counter("TxnConflicts")
         self._c_too_old = self.counters.counter("TxnTooOld")
         self._c_grv_in = self.counters.counter("GRVIn")
+        self._c_throttled = self.counters.counter("TxnThrottled")
         self._c_batches = self.counters.counter("CommitBatches")
         self._c_mutation_bytes = self.counters.counter("MutationBytes")
         self._assembly_t0: float | None = None
@@ -230,6 +231,13 @@ class Proxy:
         self.n_proxies = n_proxies
         self._rk_tps: float | None = None
         self._grv_tokens = 1.0
+        # contention throttling (docs/contention.md): hot ranges from the
+        # ratekeeper's rate reply, each with its own release-rate token
+        # bucket; commits touching an exhausted range are rejected with
+        # transaction_throttled + a server-advised backoff
+        self._throttles: list = []  # ThrottleEntry list, hottest first
+        # (begin, end) -> [tokens, last_refill_time]
+        self._throttle_buckets: dict = {}
         # deque: under throttle the line grows to thousands of waiters and
         # the pump pops from the front every tick — list.pop(0) would make
         # each handout O(queue)
@@ -390,10 +398,52 @@ class Proxy:
                 r = await self.loop.timeout(self.process.net.request(
                     self.process, ep, self.n_proxies), 1.0)
                 self._rk_tps = r.tps
+                self._set_throttles(getattr(r, "throttles", None) or [])
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise
             await self.loop.delay(KNOBS.RK_UPDATE_INTERVAL)
+
+    def _set_throttles(self, entries: list):
+        """Install the ratekeeper's throttle list, carrying over the token
+        bucket of any range that stays throttled (a fresh bucket every rate
+        reply would hand hot ranges a free burst each RK interval)."""
+        now = self.loop.now()
+        buckets = {}
+        for t in entries:
+            key = (t.begin, t.end)
+            prev = self._throttle_buckets.get(key)
+            buckets[key] = prev if prev is not None else [1.0, now]
+        self._throttles = entries
+        self._throttle_buckets = buckets
+
+    def _throttle_check(self, req: CommitTransactionRequest):
+        """Return the ThrottleEntry that rejects this commit, or None to
+        admit it. A commit touching a throttled range must spend one token
+        from that range's release-rate bucket (refilled lazily, capped at a
+        one-second burst)."""
+        if not self._throttles:
+            return None
+        now = self.loop.now()
+        for t in self._throttles:
+            hit = False
+            for begin, end in req.write_conflict_ranges:
+                if begin < t.end and t.begin < end:
+                    hit = True
+                    break
+            if not hit:
+                continue
+            bucket = self._throttle_buckets[(t.begin, t.end)]
+            tokens, last = bucket
+            tokens = min(tokens + (now - last) * t.release_tps,
+                         max(1.0, t.release_tps))
+            bucket[1] = now
+            if tokens >= 1.0:
+                bucket[0] = tokens - 1.0
+                continue  # admitted through this range's budget
+            bucket[0] = tokens
+            return t
+        return None
 
     async def _grv_pump(self):
         interval = 0.05
@@ -507,6 +557,15 @@ class Proxy:
             return
         self.stats["commits_in"] += 1
         self._c_commits_in.increment()
+        t = self._throttle_check(req)
+        if t is not None:
+            self._c_throttled.increment()
+            # detail is the informed-backoff contract (utils/errors.py):
+            # "<advised_backoff> <begin_hex> <end_hex>"
+            reply.send_error(FDBError(
+                "transaction_throttled",
+                f"{t.backoff:.6f} {t.begin.hex()} {t.end.hex()}"))
+            return
         if not self._pending:
             self._assembly_t0 = self.loop.now()  # batch-assembly span start
         self._pending.append((req, reply, self.loop.now()))

@@ -214,6 +214,13 @@ def _fuzz_api_battery(rng):
             W.RandomCloggingWorkload(), W.AttritionWorkload()]
 
 
+def _zipfian_hotkey_battery(rng):
+    # the contention loop through a recovery: zipfian RMW hammering plus
+    # attrition (worker kills -> full recoveries) and clogging
+    return [F.ZipfianHotKeyWorkload(), W.RandomCloggingWorkload(),
+            W.AttritionWorkload()]
+
+
 def _serializability_battery(rng):
     return [F.SerializabilityWorkload(), W.RandomCloggingWorkload(),
             W.AttritionWorkload()]
@@ -261,6 +268,15 @@ def _two_region_fuzz_battery(rng):
 SPECS: dict[str, Spec] = {s.name: s for s in [
     Spec("cycle", "fast", _cycle_battery),
     Spec("fuzz-api", "fast", _fuzz_api_battery),
+    # needs=flat: under two_region + attrition this workload's per-key
+    # commit ledger catches an acked-commit rollback across recovery (see
+    # ROADMAP "two-region durability under attrition") — a pre-existing
+    # exposure, tracked separately from the contention loop this spec pins
+    Spec("zipfian-hotkey", "fast", _zipfian_hotkey_battery, needs="flat",
+         # the throttle loop must ENGAGE at test scale: lower the conflict
+         # threshold so the zipfian hot range crosses it within the run
+         knobs=(("RK_THROTTLE_CONFLICT_RATE", 4.0),
+                ("RK_THROTTLE_RELEASE_TPS", 8.0))),
     Spec("serializability", "fast", _serializability_battery),
     Spec("ryow", "fast", _ryow_battery),
     Spec("conflict-range", "fast", _conflict_range_battery),

@@ -63,6 +63,7 @@ class Workload:
                         return overlay
                     continue
                 if e.name in ("not_committed", "transaction_too_old",
+                              "transaction_throttled",
                               "future_version", "timed_out",
                               "proxies_changed", "cluster_not_fully_recovered",
                               "operation_failed", "wrong_shard_server",

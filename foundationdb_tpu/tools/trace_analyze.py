@@ -162,6 +162,38 @@ def check_well_formed(events) -> list[str]:
     return problems
 
 
+_CONTENTION_KEYS = ("TxnCommitIn", "TxnCommitted", "TxnConflicts",
+                    "TxnThrottled")
+
+
+def contention_stats(events) -> dict:
+    """Cluster-wide commit admission outcomes from the proxies' cumulative
+    counter records: abort_rate = conflicts/commits-in, throttle_rate =
+    throttled/commits-in. Counters are cumulative per process, so take the
+    running max per ID and sum across IDs (a proxy that restarts re-counts
+    from zero; max-then-sum keeps each process's largest completed view)."""
+    per_id: dict[str, dict[str, int]] = {}
+    for ev in events:
+        if ev.get("Type") != "ProxyMetrics":
+            continue
+        d = per_id.setdefault(str(ev.get("ID")),
+                              dict.fromkeys(_CONTENTION_KEYS, 0))
+        for k in _CONTENTION_KEYS:
+            v = ev.get(k)
+            if isinstance(v, (int, float)):
+                d[k] = max(d[k], v)
+    tot = {k: sum(d[k] for d in per_id.values()) for k in _CONTENTION_KEYS}
+    n = tot["TxnCommitIn"]
+    return {
+        "commits_in": n,
+        "committed": tot["TxnCommitted"],
+        "conflicts": tot["TxnConflicts"],
+        "throttled": tot["TxnThrottled"],
+        "abort_rate": round(tot["TxnConflicts"] / n, 4) if n else 0.0,
+        "throttle_rate": round(tot["TxnThrottled"] / n, 4) if n else 0.0,
+    }
+
+
 def analyze(events) -> dict:
     spans, unmatched = pair_spans(events)
     flows = transaction_timelines(events)
@@ -171,6 +203,7 @@ def analyze(events) -> dict:
         "unmatched": len(unmatched),
         "flows": len(flows),
         "stages": stage_stats(spans),
+        "contention": contention_stats(events),
     }
 
 
@@ -182,6 +215,13 @@ def format_report(report: dict) -> str:
     for stage, st in report["stages"].items():
         lines.append(f"{stage:<28} {st['n']:>7} {st['p50']:>10.6f} "
                      f"{st['p99']:>10.6f} {st['total']:>10.3f}")
+    con = report.get("contention")
+    if con and con["commits_in"]:
+        lines.append(
+            f"contention: commits_in={con['commits_in']} "
+            f"committed={con['committed']} "
+            f"abort_rate={con['abort_rate']:.4f} "
+            f"throttle_rate={con['throttle_rate']:.4f}")
     return "\n".join(lines)
 
 

@@ -57,6 +57,11 @@ _ERRORS = {
     # delivered; safe to retry at the transaction level (the reference's
     # request_maybe_delivered contract for idempotent/retried requests)
     "request_maybe_delivered": (1038, True),
+    # ratekeeper-driven contention throttle: the proxy refused a commit
+    # touching a hot range; detail carries "<advised_backoff> <begin_hex>
+    # <end_hex>" so on_error can wait the server-advised time (the
+    # reference's tag_throttled, error_definitions.h 1213)
+    "transaction_throttled": (1213, True),
     "master_recovery_failed": (1200, False),
     "master_tlog_failed": (1201, False),
     "master_proxy_failed": (1204, False),

@@ -166,6 +166,24 @@ KNOBS.init("RK_TARGET_TLOG_BYTES", 2_000_000, (200_000,))  # worst log queue
 KNOBS.init("RK_BASE_TPS", 100_000.0)  # unthrottled budget
 KNOBS.init("RK_SMOOTHING", 0.5)  # exponential smoothing per update
 
+# --- Contention management (Ratekeeper.actor.cpp tag throttling +
+# DataDistributionTracker read-hot-shard detection, re-aimed at write
+# conflicts; see docs/contention.md) ---
+KNOBS.init("CONTENTION_THROTTLE_ENABLED", True)
+KNOBS.init("HOTSPOT_HALF_LIFE", 2.0)  # sketch decay half-life, seconds
+KNOBS.init("HOTSPOT_MAX_BUCKETS", 256, (16,))  # sketch size bound
+KNOBS.init("HOTSPOT_TOP_K", 8)  # ranges per RESOLVER_HOT_RANGES snapshot
+# a range whose decayed conflict rate exceeds this is throttled
+KNOBS.init("RK_THROTTLE_CONFLICT_RATE", 25.0, (2.0,))
+# commits/sec the WHOLE proxy fleet may release into a throttled range
+KNOBS.init("RK_THROTTLE_RELEASE_TPS", 50.0)
+KNOBS.init("RK_THROTTLE_BACKOFF", 0.25)  # server-advised client backoff, s
+KNOBS.init("RK_THROTTLE_MAX_BACKOFF", 2.0)  # advised-backoff ceiling
+# DD conflict-split trigger: sustained conflict rate on a shard splits it
+# even when its byte count is small (the hot-shard half of shardSplitter)
+KNOBS.init("DD_SHARD_SPLIT_CONFLICT_RATE", 50.0)
+KNOBS.init("DD_HOT_SHARD_ROUNDS", 2)  # consecutive hot DD rounds before split
+
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
 KNOBS.init("CC_PREEMPT_INTERVAL_SECONDS", 5.0)  # betterMasterExists poll
 KNOBS.init("STORAGE_ENGINE", "memory")  # "memory" | "ssd" (KeyValueStoreType)

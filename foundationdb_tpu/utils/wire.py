@@ -560,3 +560,10 @@ def _register_all():
         (46, I.GetValuesRequest), (47, I.GetValuesReply),
     ]:
         register(tid, cls)
+
+    from foundationdb_tpu.server import hotspot as hs
+
+    for tid, cls in [
+        (48, hs.HotRange), (49, hs.HotRangesReply), (50, hs.ThrottleEntry),
+    ]:
+        register(tid, cls)

@@ -1,0 +1,323 @@
+"""Contention management: hotspot sketch, throttle computation, informed
+backoff (docs/contention.md).
+
+Covers the subsystem's seams in isolation: the resolver-side sketch (decay,
+merge, top-k determinism, bounded eviction), the ratekeeper's throttle-list
+computation, the wire roundtrip of the new structs (including backward
+compatibility of the extended RateInfoReply), and the client's decorrelated-
+jitter + server-advised retry schedule under sim determinism.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.transaction import Transaction
+from foundationdb_tpu.core.eventloop import EventLoop
+from foundationdb_tpu.server import ratekeeper as rk
+from foundationdb_tpu.server.hotspot import (
+    HotRange, HotRangeSketch, HotRangesReply, ThrottleEntry, overlaps)
+from foundationdb_tpu.utils import wire
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+R_HOT = (b"hot", b"hot\x00")
+R_COLD = (b"cold", b"cold\x00")
+
+
+# ---------------------------------------------------------------------------
+# sketch
+# ---------------------------------------------------------------------------
+
+def test_sketch_decay_halves_per_half_life():
+    s = HotRangeSketch(half_life=2.0, max_buckets=16)
+    s.record([R_HOT], now=0.0, weight=8.0)
+    r0 = s.rate(*R_HOT, now=0.0)
+    assert r0 > 0.0
+    assert s.rate(*R_HOT, now=2.0) == pytest.approx(r0 / 2)
+    assert s.rate(*R_HOT, now=4.0) == pytest.approx(r0 / 4)
+    assert s.rate(b"never", b"seen", now=0.0) == 0.0
+
+
+def test_sketch_rate_tracks_steady_conflict_rate():
+    """At a steady R conflicts/sec the decayed estimate converges to ~R
+    (the C * ln2 / half_life normalization)."""
+    s = HotRangeSketch(half_life=2.0, max_buckets=16)
+    for i in range(400):
+        s.record([R_HOT], now=i * 0.01, weight=1.0)  # 100 conflicts/sec
+    est = s.rate(*R_HOT, now=4.0)
+    assert 70.0 < est < 130.0, est
+
+
+def test_sketch_merge_sums_decayed_mass():
+    a = HotRangeSketch(half_life=2.0, max_buckets=16)
+    b = HotRangeSketch(half_life=2.0, max_buckets=16)
+    a.record([R_HOT], now=1.0, weight=4.0)
+    b.record([R_HOT], now=1.0, weight=4.0)
+    b.record([R_COLD], now=1.0, weight=2.0)
+    a.merge(b, now=1.0)
+    # merged mass = 4 + 4 = 8; rate = mass * ln2 / half_life
+    assert a.rate(*R_HOT, now=1.0) == pytest.approx(8.0 * 0.6931472 / 2.0,
+                                                    rel=1e-5)
+    assert a.rate(*R_COLD, now=1.0) > 0.0
+    assert len(a) == 2
+
+
+def test_sketch_top_k_deterministic_order():
+    """Equal-rate ranges order by (begin, end) — the snapshot never flaps."""
+    s = HotRangeSketch(half_life=2.0, max_buckets=16)
+    for key in (b"b", b"a", b"c"):
+        s.record([(key, key + b"\x00")], now=0.0, weight=3.0)
+    s.record([R_HOT], now=0.0, weight=9.0)
+    top = s.top_k(3, now=0.0)
+    assert [t.begin for t in top] == [b"hot", b"a", b"b"]
+    assert top[0].rate > top[1].rate == top[2].rate
+    # and the same content always yields the same list
+    s2 = HotRangeSketch(half_life=2.0, max_buckets=16)
+    for key in (b"c", b"a", b"b"):  # insertion order must not matter
+        s2.record([(key, key + b"\x00")], now=0.0, weight=3.0)
+    s2.record([R_HOT], now=0.0, weight=9.0)
+    assert s2.top_k(3, now=0.0) == top
+
+
+def test_sketch_bounded_eviction_keeps_hottest():
+    s = HotRangeSketch(half_life=2.0, max_buckets=4)
+    s.record([R_HOT], now=0.0, weight=100.0)
+    for i in range(50):
+        s.record([(b"t%03d" % i, b"t%03d\x00" % i)], now=float(i) * 0.01)
+    assert len(s) <= 4
+    assert s.rate(*R_HOT, now=0.5) > 0.0, "hottest bucket was evicted"
+
+
+def test_sketch_prune_drops_dead_buckets():
+    s = HotRangeSketch(half_life=1.0, max_buckets=16)
+    s.record([R_HOT], now=0.0)
+    s.record([R_COLD], now=0.0, weight=1000.0)
+    s.prune(now=15.0)  # R_HOT decayed to ~3e-5, R_COLD still ~0.03
+    assert len(s) == 1
+    assert s.rate(*R_COLD, now=15.0) > 0.0
+
+
+def test_overlaps_half_open_and_infinite_end():
+    assert overlaps(b"a", b"b", b"a", b"b")
+    assert overlaps(b"a", b"c", b"b", b"d")
+    assert not overlaps(b"a", b"b", b"b", b"c")  # half-open: no touch
+    assert overlaps(b"x", b"y", b"w", None)  # None = +infinity
+    assert not overlaps(b"a", b"b", b"c", None)
+
+
+# ---------------------------------------------------------------------------
+# wire
+# ---------------------------------------------------------------------------
+
+def test_throttle_structs_roundtrip():
+    h = HotRangesReply(
+        ranges=[HotRange(begin=b"k1", end=b"k2", rate=12.5)], total_rate=13.0)
+    assert wire.loads(wire.dumps(h)) == h
+    t = ThrottleEntry(begin=b"a", end=b"b", release_tps=10.0, backoff=0.5)
+    assert wire.loads(wire.dumps(t)) == t
+    r = rk.RateInfoReply(tps=500.0, throttles=[t])
+    assert wire.loads(wire.dumps(r)) == r
+
+
+def test_rate_reply_backward_compatible_with_bare_tps_schema():
+    """A peer on the pre-contention schema sends RateInfoReply with only the
+    tps field; the decoder must fill `throttles` from its default."""
+    tid = wire.type_id(rk.RateInfoReply)
+    out = bytearray([wire.MAGIC, wire.WIRE_VERSION, ord("R")])
+    wire._w_varint(out, tid)
+    wire._w_varint(out, 1)  # old schema: one field
+    wire._encode_value(out, 100.0)
+    got = wire.loads(bytes(out))
+    assert got == rk.RateInfoReply(tps=100.0, throttles=[])
+
+
+# ---------------------------------------------------------------------------
+# ratekeeper throttle computation
+# ---------------------------------------------------------------------------
+
+def _mk_rk():
+    """A Ratekeeper with no cluster behind it (update loop never sampled)."""
+    from foundationdb_tpu.core.sim import SimNetwork
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(3))
+    proc = net.new_process("rk:0")
+    keeper = rk.Ratekeeper(proc)
+    # only the pure computation is under test: stop the sampling/trace
+    # actors so no never-awaited coroutine outlives the test
+    keeper.shutdown()
+    loop.run_until_idle()
+    return loop, keeper
+
+
+def test_compute_throttles_threshold_and_backoff_scaling():
+    KNOBS.set("RK_THROTTLE_CONFLICT_RATE", 10.0)
+    KNOBS.set("RK_THROTTLE_BACKOFF", 0.2)
+    KNOBS.set("RK_THROTTLE_MAX_BACKOFF", 1.0)
+    _loop, keeper = _mk_rk()
+    replies = [
+        HotRangesReply(ranges=[HotRange(b"a", b"b", 6.0),
+                               HotRange(b"c", b"d", 30.0)], total_rate=36.0),
+        HotRangesReply(ranges=[HotRange(b"a", b"b", 6.0),
+                               HotRange(b"e", b"f", 200.0)], total_rate=206.0),
+        None,  # a dead resolver must not break the computation
+    ]
+    out = keeper._compute_throttles(replies)
+    # a+b merged to 12 (throttled), c..d 30, e..f 200; hottest first
+    assert [(t.begin, t.end) for t in out] == [(b"e", b"f"), (b"c", b"d"),
+                                              (b"a", b"b")]
+    by_range = {(t.begin, t.end): t for t in out}
+    assert by_range[(b"a", b"b")].backoff == pytest.approx(0.2 * 12 / 10)
+    assert by_range[(b"c", b"d")].backoff == pytest.approx(0.2 * 30 / 10)
+    assert by_range[(b"e", b"f")].backoff == 1.0  # capped
+    assert keeper.stats["hot_total_rate"] == pytest.approx(242.0)
+    # determinism: same snapshots -> identical list
+    assert keeper._compute_throttles(replies) == out
+
+
+def test_rate_reply_divides_release_budget_across_proxies():
+    KNOBS.set("RK_THROTTLE_CONFLICT_RATE", 10.0)
+    KNOBS.set("RK_THROTTLE_RELEASE_TPS", 40.0)
+    _loop, keeper = _mk_rk()
+    keeper.throttles = keeper._compute_throttles(
+        [HotRangesReply(ranges=[HotRange(b"a", b"b", 50.0)], total_rate=50.0)])
+
+    got = []
+
+    class _Reply:
+        def send(self, v):
+            got.append(v)
+
+    keeper._on_get_rate(4, _Reply())
+    r = got[0]
+    assert r.tps == pytest.approx(keeper.tps / 4)
+    assert len(r.throttles) == 1
+    assert r.throttles[0].release_tps == pytest.approx(40.0 / 4)
+    # the keeper's own list is not mutated by the per-proxy division
+    assert keeper.throttles[0].release_tps == pytest.approx(40.0)
+
+
+# ---------------------------------------------------------------------------
+# client retry schedule (satellite: decorrelated jitter + informed backoff)
+# ---------------------------------------------------------------------------
+
+class _FakeDB:
+    """Just enough of Database for Transaction.on_error: the loop, the
+    deterministic rng, and the real penalty-cache methods."""
+
+    def __init__(self, loop, seed=7):
+        from foundationdb_tpu.client.database import Database
+        self.loop = loop
+        self._rng = DeterministicRandom(seed)
+        self._range_penalties = {}
+        self._note_throttle = Database._note_throttle.__get__(self)
+        self._penalty_wait = Database._penalty_wait.__get__(self)
+
+
+def _retry_schedule(loop, seed, n=8, error_name="not_committed"):
+    db = _FakeDB(loop, seed)
+    tr = Transaction(db)
+    sleeps = []
+
+    async def drive():
+        for _ in range(n):
+            t0 = loop.now()
+            await tr.on_error(FDBError(error_name))
+            sleeps.append(loop.now() - t0)
+
+    loop.run_future(loop.spawn(drive()))
+    return sleeps
+
+
+def test_backoff_is_decorrelated_jitter_with_cap():
+    loop = EventLoop()
+    sleeps = _retry_schedule(loop, seed=7, n=10)
+    base, cap = KNOBS.DEFAULT_BACKOFF, KNOBS.MAX_BACKOFF
+    prev = base
+    for s in sleeps:
+        assert base <= s <= cap + 1e-12, s
+        assert s <= max(base, prev * 3) + 1e-12, \
+            f"sleep {s} exceeds decorrelated bound {prev * 3}"
+        prev = s
+    # jitter actually varies (not bare doubling)
+    assert len({round(s, 6) for s in sleeps}) > 3
+
+
+def test_backoff_schedule_is_deterministic_under_sim():
+    """Same rng seed -> the exact same retry schedule (pinned)."""
+    a = _retry_schedule(EventLoop(), seed=42, n=8)
+    b = _retry_schedule(EventLoop(), seed=42, n=8)
+    assert a == b
+    c = _retry_schedule(EventLoop(), seed=43, n=8)
+    assert a != c
+
+
+def test_backoff_respects_retry_limit():
+    loop = EventLoop()
+    db = _FakeDB(loop)
+    tr = Transaction(db)
+    tr.set_option(501, 2)  # retry_limit
+
+    async def drive():
+        await tr.on_error(FDBError("not_committed"))
+        await tr.on_error(FDBError("not_committed"))
+        with pytest.raises(FDBError):
+            await tr.on_error(FDBError("not_committed"))
+
+    loop.run_future(loop.spawn(drive()))
+
+
+def test_on_error_raises_non_retryable():
+    loop = EventLoop()
+    tr = Transaction(_FakeDB(loop))
+
+    async def drive():
+        with pytest.raises(FDBError):
+            await tr.on_error(FDBError("operation_failed"))
+
+    loop.run_future(loop.spawn(drive()))
+
+
+def test_throttled_error_honors_advised_backoff_and_penalty_cache():
+    loop = EventLoop()
+    db = _FakeDB(loop)
+    tr = Transaction(db)
+    advised = 0.8
+    begin, end = b"hot", b"hot\x00"
+    detail = f"{advised} {begin.hex()} {end.hex()}"
+
+    async def drive():
+        tr.set(b"hot", b"v")
+        t0 = loop.now()
+        await tr.on_error(FDBError("transaction_throttled", detail))
+        waited = loop.now() - t0
+        assert waited >= advised - 1e-9, \
+            f"ignored server-advised backoff: {waited}"
+        # the penalty landed in the shared cache
+        assert db._range_penalties, "no penalty cached"
+        # a SECOND transaction writing the same key inherits the penalty
+        tr2 = Transaction(db)
+        tr2.set(b"hot", b"v2")
+        t1 = loop.now()
+        await tr2.on_error(FDBError("not_committed"))
+        assert loop.now() - t1 >= (advised - waited) - 1e-9
+        # a transaction writing elsewhere does NOT
+        tr3 = Transaction(db)
+        tr3.set(b"elsewhere", b"v")
+        t2 = loop.now()
+        await tr3.on_error(FDBError("not_committed"))
+        assert loop.now() - t2 <= KNOBS.MAX_BACKOFF + 1e-9
+
+    loop.run_future(loop.spawn(drive()))
+
+
+def test_penalty_cache_prunes_expired_entries():
+    loop = EventLoop()
+    db = _FakeDB(loop)
+    db._range_penalties[(b"a", b"b")] = 0.5  # expires at t=0.5
+
+    async def drive():
+        await loop.delay(1.0)
+        assert db._penalty_wait([(b"a", b"b")]) == 0.0
+        assert not db._range_penalties, "expired penalty not pruned"
+
+    loop.run_future(loop.spawn(drive()))

@@ -30,6 +30,7 @@ FAST_SWEEP_SEEDS = [1, 2, 3, 4, 6, 7, 8, 10, 13, 14, 15, 16, 18, 19]
 # tier-1. Seeds picked for cheap draws (mostly oracle backend).
 PINNED_FAST = [
     ("cycle", 15),            # single/memory/oracle
+    ("zipfian-hotkey", 15),   # single/memory/oracle (needs flat)
     ("conflict-range", 2),    # single/memory/oracle
     ("fuzz-api", 19),         # single/memory/oracle, 8 workers
     ("serializability", 23),  # single/ssd/oracle

@@ -61,6 +61,47 @@ def test_transport_request_reply_loopback():
     assert not a._tasks and not b._tasks
 
 
+def test_transport_error_detail_survives_the_wire():
+    """A handler's FDBError detail must reach the remote caller intact:
+    transaction_throttled carries the advised backoff + hot range there,
+    and a client that loses it degrades to blind-jitter retry. Bare-name
+    errors keep the old single-string wire shape."""
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.utils.errors import FDBError
+
+    loop = RealEventLoop()
+    a = NetTransport(loop, f"127.0.0.1:{free_port()}")
+    b = NetTransport(loop, f"127.0.0.1:{free_port()}")
+    a.start()
+    b.start()
+    try:
+        def throttler(payload, reply):
+            reply.send_error(FDBError("transaction_throttled",
+                                      "0.5 6b3030 6b303100"))
+        b.process.register(43, throttler)
+
+        def plain(payload, reply):
+            reply.send_error(FDBError("not_committed"))
+        b.process.register(44, plain)
+
+        async def call(token):
+            try:
+                await a.request(a.process, Endpoint(b.address, token), None)
+                return None
+            except FDBError as e:
+                return e
+        e = loop.run_future(loop.spawn(call(43)), max_time=10.0)
+        assert e.name == "transaction_throttled"
+        assert e.detail == "0.5 6b3030 6b303100"
+        e = loop.run_future(loop.spawn(call(44)), max_time=10.0)
+        assert e.name == "not_committed"
+        assert e.detail == ""
+    finally:
+        a.close()
+        b.close()
+
+
 def test_multiprocess_cluster_serves_gets_and_commits(tmp_path):
     """Boot a real multi-OS-process cluster (txn subsystem in one server
     process, storage in another) and run transactions against it from this
