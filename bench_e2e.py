@@ -78,7 +78,7 @@ def _spawn_server(spec: dict, env: dict) -> subprocess.Popen:
 
 
 def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
-                  trace_dir=None, extra_knobs=None):
+                  trace_dir=None, extra_knobs=None, n_grv_proxies=0):
     from foundationdb_tpu.server.interfaces import Token
 
     txn_knobs = {"CONFLICT_BACKEND": backend}
@@ -116,8 +116,10 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
         # commit window directly divides conflict-engine load: 20ms windows
         # → ~50 steps/s ≈ 1.5 cores of XLA on a 1-core host (the r5
         # device-vs-oracle e2e inversion); 60ms windows → ~16 steps/s with
-        # 2-3 chunks each, which fits.
-        batch_knobs["COMMIT_TRANSACTION_BATCH_INTERVAL_MIN"] = 0.06
+        # 2-3 chunks each, which fits. The batcher is ADAPTIVE now: raising
+        # the MAX (not the MIN) lets it slide to 60ms windows only when the
+        # arrival rate saturates — light load still flushes at the fast MIN.
+        batch_knobs["COMMIT_TRANSACTION_BATCH_INTERVAL_MAX"] = 0.06
 
     p_core = f"127.0.0.1:{_free_port()}"
     # n_proxies=0: merged topology — the proxy lives in the core process
@@ -126,6 +128,9 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
     merged = n_proxies == 0
     p_proxies = ([p_core] if merged
                  else [f"127.0.0.1:{_free_port()}" for _ in range(n_proxies)])
+    # dedicated GRV proxies always get their own processes: a GRV-only role
+    # co-located with a commit proxy would displace its GRV/ping tokens
+    p_grv = [f"127.0.0.1:{_free_port()}" for _ in range(n_grv_proxies)]
     p_storages = [f"127.0.0.1:{_free_port()}" for _ in range(n_storage)]
 
     # keyspace split into n_storage contiguous shards over k%06d
@@ -176,6 +181,20 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
                 "knobs": dict(batch_knobs, **(extra_knobs or {})),
                 "roles": [proxy_role(i, addr)],
             })
+    for i, addr in enumerate(p_grv):
+        proxy_specs.append({
+            "listen": addr,
+            "data_dir": os.path.join(tmp, f"grvproxy{i}"),
+            "knobs": dict(extra_knobs or {}),
+            "roles": [{"role": "grv_proxy", "args": {
+                "proxy_id": max(n_proxies, 1) + i,
+                "n_proxies": max(n_grv_proxies, 1),
+                "other_proxies": list(p_proxies),
+                "master": {"address": p_core,
+                           "token": Token.MASTER_GET_COMMIT_VERSION},
+                "ratekeeper": p_core,
+            }}],
+        })
     storage_specs = []
     for t, addr in enumerate(p_storages):
         storage_specs.append({
@@ -231,12 +250,12 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
             buf += chunk
         sel.close()
         assert buf.startswith(b"ready"), buf[:120]
-    return procs, p_proxies, boundaries, p_storages
+    return procs, p_proxies, boundaries, p_storages, p_grv
 
 
 # ---------------------------------------------------------------- client side
 
-def _make_db(loop, proxies, boundaries, storages):
+def _make_db(loop, proxies, boundaries, storages, grv_proxies=None):
     from foundationdb_tpu.client.database import Database, LocationCache
     from foundationdb_tpu.net.transport import NetTransport
 
@@ -244,7 +263,8 @@ def _make_db(loop, proxies, boundaries, storages):
     client.start()
     db = Database(client.process, proxies=list(proxies),
                   locations=LocationCache(list(boundaries),
-                                          [[s] for s in storages]))
+                                          [[s] for s in storages]),
+                  grv_proxies=list(grv_proxies or []))
     return client, db
 
 
@@ -382,7 +402,8 @@ def worker_main(spec: dict):
     loop = RealEventLoop()
     client, db = _make_db(loop, spec["proxies"],
                           [bytes.fromhex(b) for b in spec["boundaries"]],
-                          spec["storages"])
+                          spec["storages"],
+                          grv_proxies=spec.get("grv_proxies"))
     print("ready", flush=True)
     assert sys.stdin.readline().strip() == "GO"
 
@@ -425,14 +446,16 @@ def _stage_breakdown(trace_dir: str) -> dict | None:
     rep = trace_analyze.analyze(trace_analyze.load_events(paths))
     return {"files": len(paths), "flows": rep["flows"],
             "spans": rep["spans"], "unmatched": rep["unmatched"],
-            "stages": rep["stages"], "contention": rep["contention"]}
+            "stages": rep["stages"],
+            "queueing_ratio": rep["queueing_ratio"],
+            "contention": rep["contention"]}
 
 
 def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         n_proxies: int = 0, n_storage: int = 1,
         n_client_procs: int = 2, trace: bool = False,
         phases: tuple = ("write", "read", "mixed"),
-        extra_knobs: dict | None = None) -> dict:
+        extra_knobs: dict | None = None, n_grv_proxies: int = 0) -> dict:
     """One pass per phase; returns the report dict."""
     from foundationdb_tpu.net.transport import RealEventLoop
 
@@ -441,12 +464,18 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
     if trace:
         trace_dir = os.path.join(tmp, "traces")
         os.makedirs(trace_dir, exist_ok=True)
-    procs, p_proxies, boundaries, p_storages = _boot_cluster(
+    procs, p_proxies, boundaries, p_storages, p_grv = _boot_cluster(
         tmp, backend, n_proxies, n_storage, trace_dir=trace_dir,
-        extra_knobs=extra_knobs)
+        extra_knobs=extra_knobs, n_grv_proxies=n_grv_proxies)
+    # topology records what was actually RECRUITED, not the requested knobs:
+    # the merged layout runs one co-located commit proxy, not zero (the r09
+    # rows said "proxies": 0 for a run that had one)
     report: dict = {"clients": clients, "conflict_backend": backend,
-                    "topology": {"proxies": n_proxies, "storage": n_storage,
-                                 "client_procs": n_client_procs}}
+                    "topology": {"commit_proxies": len(p_proxies),
+                                 "grv_proxies": len(p_grv),
+                                 "storage": n_storage,
+                                 "client_procs": n_client_procs,
+                                 "merged_core": n_proxies == 0}}
     if backend != "oracle" and os.environ.get("FDBTPU_E2E_FORCE_CPU"):
         report["accelerator"] = "cpu-fallback"
         report["detect_evaluator"] = (
@@ -460,7 +489,8 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
     try:
         # preload with an in-process client
         loop = RealEventLoop()
-        client, db = _make_db(loop, p_proxies, boundaries, p_storages)
+        client, db = _make_db(loop, p_proxies, boundaries, p_storages,
+                              grv_proxies=p_grv)
 
         async def preload():
             from foundationdb_tpu.utils.errors import FDBError
@@ -493,6 +523,7 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
             for k in range(n_client_procs):
                 spec = {"kind": kind, "clients": per[k],
                         "seconds": seconds, "proxies": p_proxies,
+                        "grv_proxies": p_grv,
                         "boundaries": [b.hex() for b in boundaries],
                         "storages": p_storages}
                 workers.append(subprocess.Popen(
@@ -594,6 +625,6 @@ if __name__ == "__main__":
         out["oracle"]["latency_100_clients"] = {
             k: v for k, v in run(clients=100, seconds=4.0,
                                  n_client_procs=1).items()
-            if k in ("write", "read", "mixed")}
+            if k in ("topology", "write", "read", "mixed")}
     print(json.dumps(out if len(backends) > 1 else out[backends[0]],
                      indent=2))

@@ -12,6 +12,8 @@ round-trip per GRV_BATCH_INTERVAL, like readVersionBatcher.
 
 from __future__ import annotations
 
+from collections import deque
+
 from foundationdb_tpu.client.transaction import Transaction
 from foundationdb_tpu.core.eventloop import ActorTask
 from foundationdb_tpu.core.future import Future, all_of
@@ -137,7 +139,8 @@ class Database:
     def __init__(self, process: SimProcess, proxies: list[str] | None = None,
                  locations: LocationCache | None = None,
                  rng: DeterministicRandom | None = None,
-                 coordinators: list[str] | None = None):
+                 coordinators: list[str] | None = None,
+                 grv_proxies: list[str] | None = None):
         """`locations` is the shard-location cache; statically-built clusters
         seed it directly, coordinator-discovered ones fill it via refresh().
 
@@ -147,7 +150,10 @@ class Database:
         (MonitorLeader.actor.cpp + monitorClientInfo, NativeAPI:497)."""
         self.process = process
         self.loop = process.net.loop
-        self.proxies = list(proxies or [])  # proxy process addresses
+        self.proxies = list(proxies or [])  # commit proxy process addresses
+        # dedicated GRV pool (grv_proxy/commit_proxy split): read-version
+        # requests route here when non-empty, commits to `proxies`
+        self.grv_proxies = list(grv_proxies or [])
         self.locations = locations or LocationCache()
         self.coordinators = list(coordinators or [])
         self._rng = rng or DeterministicRandom(0xDB)
@@ -173,6 +179,15 @@ class Database:
         # this database's transactions, so one throttled commit teaches
         # every subsequent retry touching that range to wait it out.
         self._range_penalties: dict[tuple[bytes, bytes], float] = {}
+        # commit admission control (docs/performance.md): an AIMD budget
+        # bounds in-flight commits per Database, so N client coroutines
+        # sharing this handle stop stuffing the proxy queue they are
+        # measuring. Deferred commits wait in FIFO order.
+        self._commit_budget = float(KNOBS.CLIENT_COMMIT_INITIAL_IN_FLIGHT)
+        self._commits_in_flight = 0
+        self._commit_queue: deque = deque()  # deferred send thunks
+        self._commit_lat_floor: float | None = None
+        self._last_budget_cut = float("-inf")
 
     def _note_throttle(self, error) -> float:
         """Record a transaction_throttled error's advised backoff in the
@@ -261,6 +276,8 @@ class Database:
                         None), 2.0)
                     if info.recovery_state == "accepting_commits" and info.proxies:
                         self.proxies = list(info.proxies)
+                        self.grv_proxies = list(
+                            getattr(info, "grv_proxies", None) or [])
                         addr_of_tag = {tag: addr for addr, tag in info.storages}
                         boundaries = list(info.shard_boundaries)
                         self.locations.update(
@@ -287,9 +304,12 @@ class Database:
     # -- RPC plumbing used by Transaction --
 
     def _pick_proxy(self, token: int) -> Endpoint:
-        if not self.proxies:
+        pool = self.proxies
+        if token == Token.PROXY_GET_READ_VERSION and self.grv_proxies:
+            pool = self.grv_proxies
+        if not pool:
             raise FDBError("cluster_not_fully_recovered", "no proxies known")
-        addr = self.proxies[self._rng.randint(0, len(self.proxies) - 1)]
+        addr = pool[self._rng.randint(0, len(pool) - 1)]
         return Endpoint(addr, token)
 
     def _grv(self) -> Future:
@@ -764,16 +784,94 @@ class Database:
     def _commit(self, req) -> Future:
         span_id = self._next_span_id("c")
         req.debug_id = span_id  # proxy attaches this to its batch span
-        t0 = self.loop.now()
-        f = self.process.net.request(
-            self.process, self._pick_proxy(Token.PROXY_COMMIT), req)
+        t_q = self.loop.now()  # arrival at the client, before admission wait
+        out = Future()
 
-        def _close(_f):
-            # emit-on-settle: both records land together whether the commit
-            # succeeded, conflicted, or the proxy died mid-flight
-            g_trace_batch.span_begin("CommitSpan", span_id, "Client.Commit",
-                                     at=t0)
-            g_trace_batch.span_end("CommitSpan", span_id, "Client.Commit",
-                                   at=self.loop.now())
-        f.add_callback(_close)
-        return f
+        def send():
+            self._commits_in_flight += 1
+            t_send = self.loop.now()
+            if t_send - t_q > 1e-9:
+                # time spent parked behind the admission budget — client-side
+                # backpressure, not server queueing, so it gets its own span
+                # rather than inflating Client.Commit.
+                g_trace_batch.span_begin("CommitSpan", span_id,
+                                         "Client.AdmissionWait", at=t_q)
+                g_trace_batch.span_end("CommitSpan", span_id,
+                                       "Client.AdmissionWait", at=t_send)
+            try:
+                f = self.process.net.request(
+                    self.process, self._pick_proxy(Token.PROXY_COMMIT), req)
+            except Exception as e:  # noqa: BLE001 — relay to the waiter
+                self._commits_in_flight -= 1
+                out._set_error(e)
+                self._admit_next()
+                return
+
+            def _close(_f):
+                self._commits_in_flight -= 1
+                # feed the budget BEFORE admitting the next commit so a cut
+                # takes effect on this very drain
+                self._admission_feedback(_f, self.loop.now() - t_send)
+                # emit-on-settle: both records land together whether the
+                # commit succeeded, conflicted, or the proxy died mid-flight.
+                # Begin is t_send: Client.Commit measures the commit RPC the
+                # server is responsible for; deferral behind the admission
+                # budget is the separate Client.AdmissionWait span above.
+                g_trace_batch.span_begin("CommitSpan", span_id,
+                                         "Client.Commit", at=t_send)
+                g_trace_batch.span_end("CommitSpan", span_id, "Client.Commit",
+                                       at=self.loop.now())
+                if _f.is_error():
+                    out._set_error(_f._result)
+                else:
+                    out._set(_f._result)
+                self._admit_next()
+            f.add_callback(_close)
+
+        if (not self._commit_queue
+                and self._commits_in_flight < max(1, int(self._commit_budget))):
+            send()
+        else:
+            self._commit_queue.append(send)
+        return out
+
+    def _admit_next(self):
+        while (self._commit_queue and self._commits_in_flight
+               < max(1, int(self._commit_budget))):
+            self._commit_queue.popleft()()
+
+    def _admission_feedback(self, f: Future, latency: float):
+        """AIMD on the in-flight commit budget. Multiplicative decrease on
+        the proxy's transaction_throttled signal or when a successful
+        commit's latency inflates past CLIENT_ADMISSION_LATENCY_RATIO x the
+        learned baseline — the queueing signature (server stages stay flat
+        while end-to-end latency grows, BENCH_r08). Additive increase
+        (~1 per budget's worth of acks) on healthy commits."""
+        err = f._result if f.is_error() else None
+        now = self.loop.now()
+        if isinstance(err, FDBError) and err.name == "transaction_throttled":
+            self._cut_budget(now, latency)
+            return
+        if err is not None:
+            return  # conflicts/timeouts say nothing about queueing
+        floor = self._commit_lat_floor
+        # decaying min: snaps down to fast samples, drifts up slowly so a
+        # permanently shifted baseline (topology change) is re-learned
+        self._commit_lat_floor = latency if floor is None else min(
+            latency, floor + 0.02 * (latency - floor))
+        if (floor is not None
+                and latency > KNOBS.CLIENT_ADMISSION_LATENCY_RATIO * floor):
+            self._cut_budget(now, latency)
+        else:
+            self._commit_budget = min(
+                float(KNOBS.CLIENT_COMMIT_MAX_IN_FLIGHT),
+                self._commit_budget + 1.0 / max(1.0, self._commit_budget))
+
+    def _cut_budget(self, now: float, latency: float):
+        # one cut per RTT-ish window: every in-flight commit observes the
+        # same congestion event, and N cuts for one event would collapse
+        # the budget straight to the floor
+        if now - self._last_budget_cut >= max(latency, 0.01):
+            self._commit_budget = max(
+                1.0, self._commit_budget * KNOBS.CLIENT_ADMISSION_DECREASE)
+            self._last_budget_cut = now

@@ -41,6 +41,9 @@ def build_role(process, role: str, args: dict):
             boundaries=[bytes.fromhex(b) for b in args["shards"]["boundaries"]],
             tags=args["shards"]["tags"])
         return Proxy(process, **args)
+    if role == "grv_proxy":
+        from foundationdb_tpu.server.proxy import Proxy
+        return Proxy(process, grv_only=True, **args)
     if role == "resolver":
         from foundationdb_tpu.server.resolver import Resolver
         return Resolver(process, **args)

@@ -31,7 +31,8 @@ class SimCluster:
     def __init__(self, seed: int = 0, n_proxies: int = 1, n_resolvers: int = 1,
                  n_tlogs: int = 1, n_storage: int = 1,
                  loop: EventLoop | None = None,
-                 net: SimNetwork | None = None, name_prefix: str = ""):
+                 net: SimNetwork | None = None, name_prefix: str = "",
+                 n_grv_proxies: int = 0):
         """`loop`/`net`/`name_prefix` let several clusters share one
         deterministic simulation (the DR topology: two live databases)."""
         self.loop = loop or EventLoop()
@@ -43,6 +44,8 @@ class SimCluster:
         # -- processes --
         self.master_proc = self.net.new_process(f"{P}master:0", dc_id="dc0")
         self.proxy_procs = [self.net.new_process(f"{P}proxy:{i}") for i in range(n_proxies)]
+        self.grv_proxy_procs = [self.net.new_process(f"{P}grvproxy:{i}")
+                                for i in range(n_grv_proxies)]
         self.resolver_procs = [self.net.new_process(f"{P}resolver:{i}") for i in range(n_resolvers)]
         self.tlog_procs = [self.net.new_process(f"{P}tlog:{i}") for i in range(n_tlogs)]
         self.storage_procs = [self.net.new_process(f"{P}storage:{i}") for i in range(n_storage)]
@@ -53,6 +56,7 @@ class SimCluster:
                         for p in self.resolver_procs]
         tlog_eps = [Endpoint(p.address, Token.TLOG_COMMIT) for p in self.tlog_procs]
         self.proxy_addrs = [p.address for p in self.proxy_procs]
+        self.grv_proxy_addrs = [p.address for p in self.grv_proxy_procs]
 
         # -- role state --
         self.master = Master(self.master_proc)
@@ -99,8 +103,15 @@ class SimCluster:
             Proxy(p, proxy_id=i, master=master_ep, resolvers=resolver_map,
                   tlogs=tlog_eps, shards=shard_map,
                   other_proxies=[a for a in self.proxy_addrs if a != p.address],
-                  validation_scope=name_prefix)
+                  validation_scope=name_prefix, n_proxies=n_proxies)
             for i, p in enumerate(self.proxy_procs)]
+        # GRV-only proxies confirm liveness against the COMMIT pool — their
+        # own committed_version never advances
+        self.grv_proxies = [
+            Proxy(p, proxy_id=n_proxies + i, master=master_ep,
+                  other_proxies=list(self.proxy_addrs),
+                  validation_scope=name_prefix, grv_only=True)
+            for i, p in enumerate(self.grv_proxy_procs)]
 
     # -- client handles --
 
@@ -111,7 +122,8 @@ class SimCluster:
         cache = LocationCache(self.shard_boundaries,
                               [p.address for p in self.storage_procs])
         return Database(proc, self.proxy_addrs, locations=cache,
-                        rng=self.rng.fork())
+                        rng=self.rng.fork(),
+                        grv_proxies=self.grv_proxy_addrs)
 
     # -- driving --
 
@@ -145,7 +157,8 @@ class RecoverableCluster:
                  usable_regions: int = 1, n_log_routers: int = 1,
                  worker_dcs: list[str] | None = None,
                  storage_worker_dcs: list[str] | None = None,
-                 coord_dcs: list[str] | None = None):
+                 coord_dcs: list[str] | None = None,
+                 n_grv_proxies: int = 0):
         from foundationdb_tpu.server.clustercontroller import (
             ClusterConfig, ClusterController)
         from foundationdb_tpu.server.coordination import Coordinator, elect_leader
@@ -155,6 +168,7 @@ class RecoverableCluster:
         self.rng = DeterministicRandom(seed)
         self.net = SimNetwork(self.loop, self.rng.fork())
         self.config = ClusterConfig(n_proxies=n_proxies,
+                                    n_grv_proxies=n_grv_proxies,
                                     n_resolvers=n_resolvers,
                                     n_tlogs=n_tlogs, n_storage=n_storage,
                                     n_replicas=n_replicas,

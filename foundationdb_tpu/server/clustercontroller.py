@@ -44,6 +44,9 @@ from foundationdb_tpu.utils.trace import TraceEvent
 @dataclass
 class ClusterConfig:
     n_proxies: int = 1
+    # dedicated GRV proxies (grv_proxy/commit_proxy split): 0 keeps the
+    # combined shape where commit proxies also serve read versions
+    n_grv_proxies: int = 0
     n_resolvers: int = 1
     n_tlogs: int = 1
     n_storage: int = 1  # number of SHARDS
@@ -179,6 +182,8 @@ class ClusterController:
             targets.append(("master", info.master, Token.MASTER_METRICS))
         for a in info.proxies:
             targets.append(("proxy", a, Token.PROXY_METRICS))
+        for a in info.grv_proxies:
+            targets.append(("grv_proxy", a, Token.PROXY_METRICS))
         for a in info.resolvers:
             targets.append(("resolver", a, Token.RESOLVER_METRICS))
         last_ep = info.log_epochs[-1] if info.log_epochs else None
@@ -223,6 +228,7 @@ class ClusterController:
                 },
                 "layers": {"master": info.master,
                            "proxies": list(info.proxies),
+                           "grv_proxies": list(info.grv_proxies),
                            "resolvers": list(info.resolvers),
                            "ratekeeper": info.ratekeeper,
                            "logs": [{"epoch": ep.epoch, "begin": ep.begin,
@@ -268,7 +274,8 @@ class ClusterController:
                     "transactions_conflicted": 0, "commit_batches": 0,
                     "mutation_bytes": 0}
         for entry in roles:
-            if entry["role"] != "proxy" or "counters" not in entry:
+            if (entry["role"] not in ("proxy", "grv_proxy")
+                    or "counters" not in entry):
                 continue
             c = entry["counters"]
             workload["transactions_started"] += c.get("GRVIn", 0)
@@ -427,7 +434,8 @@ class ClusterController:
             from dataclasses import replace as _dc_replace
             cfg = _dc_replace(cfg, **{
                 k: int(v) for k, v in cc_conf.items()
-                if k in ("n_proxies", "n_resolvers", "n_tlogs", "n_replicas")})
+                if k in ("n_proxies", "n_grv_proxies", "n_resolvers",
+                         "n_tlogs", "n_replicas")})
             recovery_version = await self._lock_old_generation(old_epochs[-1])
             # close the old generation at the recovery version
             old_epochs[-1] = LogEpoch(begin=old_epochs[-1].begin,
@@ -464,7 +472,8 @@ class ClusterController:
             for dc in cfg.region_dcs:
                 sl = [a for a in stateless_all if dc_of(a) == dc]
                 lw = [a for a in log_workers_all if dc_of(a) == dc]
-                if (len(sl) >= max(1, cfg.n_proxies, cfg.n_resolvers)
+                if (len(sl) >= max(1, cfg.n_proxies + cfg.n_grv_proxies,
+                                   cfg.n_resolvers)
                         and len(lw) >= cfg.n_tlogs):
                     primary_dc = dc
                     stateless, log_workers = sl, lw
@@ -476,8 +485,11 @@ class ClusterController:
             stateless, log_workers = stateless_all, log_workers_all
         # one resolver/proxy per worker: co-locating two same-keyed roles on
         # one process would silently displace the first (single endpoint
-        # token per role kind per process)
-        if (len(stateless) < max(1, cfg.n_proxies, cfg.n_resolvers)
+        # token per role kind per process). GRV proxies count against the
+        # same stateless pool — they own the GRV token a co-located commit
+        # proxy would also register.
+        if (len(stateless) < max(1, cfg.n_proxies + cfg.n_grv_proxies,
+                                 cfg.n_resolvers)
                 or len(log_workers) < cfg.n_tlogs):
             raise FDBError("recruitment_failed", "not enough workers")
 
@@ -700,6 +712,28 @@ class ClusterController:
                     "n_proxies": cfg.n_proxies,
                     "die_on_failure": True,
                 })
+        # dedicated GRV proxies on workers AFTER the commit proxies (they
+        # register the same GRV/ping/metrics tokens, so sharing a worker
+        # with a commit proxy would displace its handlers). They confirm
+        # read versions against the COMMIT proxies' committed versions and
+        # report their own pool size to the ratekeeper, so the GRV budget
+        # divides over the pool actually serving GRVs.
+        grv_addrs = [stateless[(cfg.n_proxies + i) % len(stateless)]
+                     for i in range(cfg.n_grv_proxies)]
+        for i in range(cfg.n_grv_proxies):
+            await self._recruit_many(
+                [grv_addrs[i]], 1, "grv_proxy",
+                lambda _i, i=i: {
+                    "proxy_id": cfg.n_proxies + i,
+                    "master": Endpoint(master_addr,
+                                       Token.MASTER_GET_COMMIT_VERSION),
+                    "recovery_version": start_version,
+                    "epoch": epoch,
+                    "other_proxies": list(proxy_addrs),
+                    "ratekeeper": rk_addr,
+                    "n_proxies": max(1, cfg.n_grv_proxies),
+                    "die_on_failure": True,
+                })
 
         # ---- WRITING_CSTATE: fencing point for competing recoveries ----
         self.dbinfo.recovery_state = "writing_cstate"
@@ -758,7 +792,8 @@ class ClusterController:
             proxies=proxy_addrs, resolvers=resolver_addrs,
             log_epochs=new_epochs, storages=storages,
             shard_boundaries=boundaries, recovery_state="accepting_commits",
-            ratekeeper=rk_addr, shard_tags=shard_tags)
+            ratekeeper=rk_addr, shard_tags=shard_tags,
+            grv_proxies=grv_addrs)
         self._c_recoveries.increment()
         TraceEvent("CCRecovered", self.process.address) \
             .detail("Epoch", epoch).detail("RecoveryVersion", recovery_version) \
@@ -798,10 +833,14 @@ class ClusterController:
             self._watchers.append(self.process.spawn(
                 self._watch_epoch_role(pa, Token.PROXY_PING, epoch, "proxy"),
                 "watchProxy"))
+        for ga in grv_addrs:
+            self._watchers.append(self.process.spawn(
+                self._watch_epoch_role(ga, Token.PROXY_PING, epoch,
+                                       "grv_proxy"), "watchGrvProxy"))
         router_addrs = sorted({a for a, _u in router_of.values()})
-        for addr in sorted(set([master_addr] + proxy_addrs + resolver_addrs
-                               + tlog_addrs + sat_addrs + router_addrs
-                               + [rk_addr])):
+        for addr in sorted(set([master_addr] + proxy_addrs + grv_addrs
+                               + resolver_addrs + tlog_addrs + sat_addrs
+                               + router_addrs + [rk_addr])):
             self._watchers.append(self.process.spawn(
                 self._watch_role(addr, "txn",
                                  self._incarnations.get(addr, 0)),
@@ -1211,12 +1250,13 @@ class ClusterController:
         excluded = sorted(conf.get("excluded") or [])
         shape = {}
         cur = {"n_proxies": len(info.proxies),
+               "n_grv_proxies": len(info.grv_proxies),
                "n_resolvers": len(info.resolvers),
                "n_tlogs": len(info.log_epochs[-1].addrs[
                    :info.log_epochs[-1].n_primary
                    or len(info.log_epochs[-1].addrs)])
                if info.log_epochs else 0}
-        for k in ("n_proxies", "n_resolvers", "n_tlogs"):
+        for k in ("n_proxies", "n_grv_proxies", "n_resolvers", "n_tlogs"):
             if k in conf and conf[k] != cur[k]:
                 shape[k] = conf[k]
         want_conf = {k: v for k, v in conf.items() if k != "excluded"}
@@ -1233,10 +1273,16 @@ class ClusterController:
                 "stateless", now) if a not in ex])
             avail = {
                 "n_proxies": n_stateless,
+                "n_grv_proxies": n_stateless,
                 "n_resolvers": n_stateless,
                 "n_tlogs": len([a for a in self.registry.alive("tlog", now)
                                 if a not in ex])}
             bad = {k: v for k, v in shape.items() if v > avail[k]}
+            # commit + GRV proxies each need their own stateless worker
+            want_px = (shape.get("n_proxies", cur["n_proxies"])
+                       + shape.get("n_grv_proxies", cur["n_grv_proxies"]))
+            if want_px > n_stateless:
+                bad.setdefault("n_proxies+n_grv_proxies", want_px)
             if bad:
                 TraceEvent("CCConfigureInfeasible", self.process.address,
                            severity=30).detail("Requested", bad) \
@@ -1394,7 +1440,7 @@ class ClusterController:
             AddShardRequest(begin=lo, end=hi, source=src,
                             fence_version=fence)), 30.0)
         new_team = sorted(set(alive_in_team) | {new_tag})
-        await self._commit_metadata_txn(
+        done = await self._commit_metadata_txn(
             info,
             {systemdata.keyservers_key(lo):
                  systemdata.encode_tags(sorted(set(team) | {new_tag}))},
@@ -1409,7 +1455,7 @@ class ClusterController:
         # would look like a duplicate and skip the re-fetch — serving every
         # write since the drain from a stale replica
         self._push_team_ranges(sorted(set(team) | {new_tag}), b, new_teams,
-                               addr_of_tag)
+                               addr_of_tag, as_of_version=done)
         return True
 
     async def _shrink_team(self, info, i: int, want: int) -> bool:
@@ -1439,7 +1485,7 @@ class ClusterController:
                 .detail("Shard", i).detail("Policy", str(policy)).log()
         TraceEvent("DDShrinkTeam", self.process.address) \
             .detail("Shard", i).detail("From", team).detail("To", new_team).log()
-        await self._commit_metadata_txn(
+        done = await self._commit_metadata_txn(
             info,
             {systemdata.keyservers_key(b[i]): systemdata.encode_tags(team)},
             [Mutation(MutationType.SET_VALUE, systemdata.keyservers_key(b[i]),
@@ -1449,7 +1495,8 @@ class ClusterController:
         await self._publish_layout(b, new_teams)
         # every old member (dropped ones included) gets its remaining
         # assignments pushed — possibly empty (new_team is a subset of team)
-        self._push_team_ranges(sorted(set(team)), b, new_teams, addr_of_tag)
+        self._push_team_ranges(sorted(set(team)), b, new_teams, addr_of_tag,
+                               as_of_version=done)
         return True
 
     async def _forget_tags(self, info, tags: list[int]):
@@ -1486,7 +1533,7 @@ class ClusterController:
         TraceEvent("DDMergeShards", self.process.address) \
             .detail("At", b[i + 1].hex()).log()
         k = systemdata.keyservers_key(b[i + 1])
-        await self._commit_metadata_txn(
+        done = await self._commit_metadata_txn(
             info,
             {k: systemdata.encode_tags(teams[i + 1]),
              systemdata.keyservers_key(b[i]): systemdata.encode_tags(teams[i])},
@@ -1497,7 +1544,8 @@ class ClusterController:
         # post-merge range read spanning the former boundary would get
         # wrong_shard_server forever from a team with explicit shard_ranges
         addr_of_tag = {t: a for a, t in info.storages}
-        self._push_team_ranges(teams[i], new_b, new_teams, addr_of_tag)
+        self._push_team_ranges(teams[i], new_b, new_teams, addr_of_tag,
+                               as_of_version=done)
 
     def _tag_ranges(self, tag, boundaries, teams):
         """EVERY range `tag` serves — the union over all shards whose team
@@ -1507,7 +1555,8 @@ class ClusterController:
                  boundaries[j + 1] if j + 1 < len(boundaries) else None)
                 for j, t in enumerate(teams) if tag in t]
 
-    def _push_team_ranges(self, team, boundaries, teams, addr_of_tag):
+    def _push_team_ranges(self, team, boundaries, teams, addr_of_tag,
+                          as_of_version=None):
         lv = (self.dbinfo.epoch, self.dbinfo.version)
         for tag in team:
             if addr_of_tag.get(tag) is None:
@@ -1517,7 +1566,7 @@ class ClusterController:
                 Endpoint(addr_of_tag[tag], Token.STORAGE_SET_SHARDS),
                 SetShardsRequest(
                     shard_ranges=self._tag_ranges(tag, boundaries, teams),
-                    layout_version=lv))
+                    layout_version=lv, as_of_version=as_of_version))
 
     async def _publish_layout(self, new_b, new_teams, storages=None):
         """Shared publish step for every DD layout change: the coordinated
@@ -1598,7 +1647,7 @@ class ClusterController:
         # single-team entry, then the source stops serving the moved range
         # (stale clients get wrong_shard_server and re-resolve through the
         # published layout)
-        await self._commit_metadata_txn(
+        done = await self._commit_metadata_txn(
             info,
             {systemdata.keyservers_key(split_key):
                  systemdata.encode_tags(both)},
@@ -1606,4 +1655,5 @@ class ClusterController:
                       systemdata.keyservers_key(split_key),
                       systemdata.encode_tags(dest))])
         if dest != old_team:
-            self._push_team_ranges(old_team, new_b, new_teams, addr_of_tag)
+            self._push_team_ranges(old_team, new_b, new_teams, addr_of_tag,
+                                   as_of_version=done)

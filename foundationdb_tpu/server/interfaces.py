@@ -412,6 +412,11 @@ class SetShardsRequest:
 
     shard_ranges: list  # list[(begin, end|None)]
     layout_version: tuple | None = None  # (epoch, DBInfo.version) at push
+    # commit version of the metadata txn this layout reflects: the server
+    # drops shard revocations fenced at/below it (the layout accounts for
+    # those moves), while a delayed stale push — carrying an older version —
+    # can never lift a newer fence. None (legacy/tests) lifts nothing.
+    as_of_version: int | None = None
 
 
 @dataclass
@@ -471,6 +476,10 @@ class DBInfo:
     # team per shard: the tags of the replicas serving shard i
     # (DDTeamCollection's server teams, DataDistribution.actor.cpp:515)
     shard_tags: list[list[int]] | None = None
+    # dedicated GRV proxies (the grv_proxy/commit_proxy role split): clients
+    # route read-version requests here when non-empty, commits to `proxies`.
+    # Trailing-defaulted for wire compatibility with older encoders.
+    grv_proxies: list[str] = field(default_factory=list)
 
     def teams(self) -> list[list[int]]:
         """shard -> replica tags, defaulting to the single-replica identity

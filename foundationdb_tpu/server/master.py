@@ -37,8 +37,14 @@ class Master:
         self.deposed = False
         self.last_version_assigned = recovery_version
         self.last_version_time = self.loop.now()
-        # (proxy_id -> (request_num, reply)) retransmit dedupe window
-        self._last_reply: dict[int, tuple[int, GetCommitVersionReply]] = {}
+        # proxy_id -> {request_num: reply} retransmit dedupe window. The
+        # proxy's resolving gate keeps at most one version fetch outstanding
+        # per proxy, but with the commit pipeline window > 1 a retransmit of
+        # fetch N can still be in flight when fetch N+1 arrives — a depth-1
+        # window would forget N and re-assign it a SECOND version, forking
+        # the prevVersion chain. Keep a small bounded window per proxy.
+        self._last_reply: dict[int, dict[int, GetCommitVersionReply]] = {}
+        self._reply_window = 8
         self.counters = CounterCollection("Master", str(process.address))
         self._c_requests = self.counters.counter("VersionRequests")
         self._c_retransmits = self.counters.counter("Retransmits")
@@ -127,10 +133,11 @@ class Master:
                                       f"epoch {req.epoch} != {self.epoch}"))
             return
         self._c_requests.increment()
-        prev = self._last_reply.get(req.proxy_id)
-        if prev is not None and prev[0] == req.request_num:
+        window = self._last_reply.setdefault(req.proxy_id, {})
+        prev = window.get(req.request_num)
+        if prev is not None:
             self._c_retransmits.increment()
-            reply.send(prev[1])  # retransmit: same version again
+            reply.send(prev)  # retransmit: same version again
             return
         now = self.loop.now()
         advance = int((now - self.last_version_time) * KNOBS.VERSIONS_PER_SECOND)
@@ -141,5 +148,7 @@ class Master:
         self._c_versions.increment(advance)
         self.last_version_assigned = version
         self.last_version_time = now
-        self._last_reply[req.proxy_id] = (req.request_num, r)
+        window[req.request_num] = r
+        while len(window) > self._reply_window:
+            del window[min(window)]
         reply.send(r)

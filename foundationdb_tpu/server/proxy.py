@@ -77,6 +77,14 @@ class ShardMap:
     def _shard_of(self, key: bytes) -> int:
         return keylib.partition_index(self.boundaries, key)
 
+    def all_tags(self) -> list[int]:
+        """Every storage tag serving any shard (the broadcast set for
+        keyServers private mutations)."""
+        out: set[int] = set()
+        for team in self.tags:
+            out.update(team)
+        return sorted(out)
+
 
 @dataclass
 class ResolverMap:
@@ -107,7 +115,8 @@ class ResolverMap:
 
 class Proxy:
     def __init__(self, process: SimProcess, proxy_id: int, master: Endpoint,
-                 resolvers: ResolverMap, tlogs: list[Endpoint],
+                 resolvers: ResolverMap | None = None,
+                 tlogs: list[Endpoint] | None = None,
                  shards: ShardMap | None = None, recovery_version: int = 0,
                  other_proxies: list[str] | None = None, epoch: int = 0,
                  ratekeeper: str | None = None, n_proxies: int = 1,
@@ -117,11 +126,18 @@ class Proxy:
                  storages: list | None = None,
                  satellites: list[Endpoint] | None = None,
                  satellite_uids: list[str] | None = None,
-                 validation_scope: str = ""):
+                 validation_scope: str = "",
+                 grv_only: bool = False):
         from foundationdb_tpu.server import systemdata
         self.process = process
         self.loop = process.net.loop
         self.proxy_id = proxy_id
+        # GRV-only proxies (the reference's grv_proxy role split,
+        # GrvProxyServer.actor.cpp): serve read versions and nothing else, so
+        # a client GRV storm stops queueing behind commit batches. They keep
+        # the master lease and ratekeeper admission but carry no commit
+        # pipeline, txn state, or log system.
+        self.grv_only = grv_only
         # sim-only: which DATABASE this proxy belongs to, for the external-
         # consistency oracle — "" (the per-network global oracle, strongest:
         # it survives recoveries) unless several clusters share one sim
@@ -129,13 +145,13 @@ class Proxy:
         self.master = master
         self.epoch = epoch
         self.resolvers = resolvers
-        self.tlogs = tlogs
-        self.tlog_uids = tlog_uids or [""] * len(tlogs)
+        self.tlogs = tlogs or []
+        self.tlog_uids = tlog_uids or [""] * len(self.tlogs)
         # the ILogSystem seam (LogSystem.h:268): pushes fan out through it,
         # so a satellite log set (synchronously quorumed outside the primary
         # DC) slots in without touching the commit pipeline
         from foundationdb_tpu.server.logsystem import LogSystem
-        self.log_system = LogSystem.from_endpoints(
+        self.log_system = None if grv_only else LogSystem.from_endpoints(
             process, tlogs, uids=self.tlog_uids, satellites=satellites,
             satellite_uids=satellite_uids)
         # txnStateStore: the system keyspace subset this proxy caches,
@@ -143,14 +159,20 @@ class Proxy:
         # supplied ShardMap in statically-built clusters) and maintained by
         # metadata mutations flowing through the commit pipeline
         # (ApplyMetadataMutation.h; MasterProxyServer.actor.cpp:452-489)
-        if system_snapshot is None:
-            assert shards is not None, "need shards or system_snapshot"
-            system_snapshot = systemdata.build_keyservers_snapshot(
-                shards.boundaries, shards.tags)
-        self.txn_state = systemdata.TxnStateStore(system_snapshot)
-        self.txn_state_version = recovery_version
-        self.shards = self._shards_from_txn_state()
-        self.backup_ranges = self._backup_ranges_from_txn_state()
+        if grv_only:
+            self.txn_state = None
+            self.txn_state_version = recovery_version
+            self.shards = None
+            self.backup_ranges = []
+        else:
+            if system_snapshot is None:
+                assert shards is not None, "need shards or system_snapshot"
+                system_snapshot = systemdata.build_keyservers_snapshot(
+                    shards.boundaries, shards.tags)
+            self.txn_state = systemdata.TxnStateStore(system_snapshot)
+            self.txn_state_version = recovery_version
+            self.shards = self._shards_from_txn_state()
+            self.backup_ranges = self._backup_ranges_from_txn_state()
         # newest version through which THIS proxy has applied state-mutation
         # windows — the last_receive ack sent to resolvers. Resolvers prune
         # retained state txns by the MIN ack over all proxies, so the ack's
@@ -164,13 +186,17 @@ class Proxy:
         # readTransactionSystemState analogue, masterserver.actor.cpp:597):
         # no client write can land in an un-teed gap across a recovery.
         self._storage_addr_of_tag = {t: a for a, t in (storages or [])}
-        self._backup_seeded = storages is None  # static clusters: no seeding
+        self._backup_seeded = storages is None or grv_only
         self._seed_task = None
         if not self._backup_seeded:
             self._seed_task = process.spawn(self._seed_backup_ranges(),
                                             "seedBackupRanges")
         self.other_proxies = [Endpoint(a, Token.PROXY_GET_COMMITTED_VERSION)
                               for a in (other_proxies or [])]
+        # coalesced getLiveCommittedVersion: GRVs queue here and one peer
+        # round serves everything queued when it starts
+        self._confirm_waiters: list[tuple] = []
+        self._confirm_running = False
         self._request_num = 0
         self._batch_n = 0
         self.latest_resolving = NotifiedVersion(0)  # batch numbers
@@ -178,6 +204,16 @@ class Proxy:
         self.committed_version = NotifiedVersion(recovery_version)
         self._pending: list[tuple[CommitTransactionRequest, object]] = []
         self._batcher_armed = False
+        # adaptive batching state: smoothed commits-in rate keys the target
+        # flush interval; pending byte count feeds the BYTES_MIN trigger
+        self._pending_bytes = 0
+        self._arrival_rate = 0.0
+        self._last_arrival = self.loop.now()
+        # bounded pipeline window: batches dispatched but not yet finished.
+        # _try_flush defers when the window is full; the draining batch
+        # re-flushes the deferred pending set when it completes.
+        self._inflight_batches = 0
+        self._flush_blocked = False
         self._master_last_seen = self.loop.now()
         self.stats = {"commits_in": 0, "committed": 0, "conflicts": 0, "too_old": 0}
         # latency bands + cross-process txn timeline probes (the reference's
@@ -201,10 +237,21 @@ class Proxy:
         # statically-built clusters retry instead (their topology heals)
         self.die_on_failure = die_on_failure
         self.dead = False
-        process.register(Token.PROXY_COMMIT, self._on_commit)
+        # a GRV-only proxy registers no commit-path tokens. It still owns
+        # the GRV/ping/metrics tokens, so recruitment places it on a worker
+        # with no other proxy role; die() deregisters exactly what was
+        # registered
+        if grv_only:
+            self._tokens = (Token.PROXY_GET_READ_VERSION, Token.PROXY_PING,
+                            Token.PROXY_METRICS)
+        else:
+            self._tokens = (Token.PROXY_COMMIT, Token.PROXY_GET_READ_VERSION,
+                            Token.PROXY_GET_COMMITTED_VERSION,
+                            Token.PROXY_PING, Token.PROXY_METRICS)
+            process.register(Token.PROXY_COMMIT, self._on_commit)
+            process.register(Token.PROXY_GET_COMMITTED_VERSION,
+                             self._on_get_committed_version)
         process.register(Token.PROXY_GET_READ_VERSION, self._on_grv)
-        process.register(Token.PROXY_GET_COMMITTED_VERSION,
-                         self._on_get_committed_version)
         process.register(Token.PROXY_PING, self._on_proxy_ping)
         process.register(Token.PROXY_METRICS, self._on_metrics)
         self._counters_task = trace_counters_loop(process, self.counters)
@@ -222,7 +269,7 @@ class Proxy:
         # the outage would leave it a permanent version-chain gap that only
         # a recovery (new generation) could clear.
         self._empty_task = None
-        if die_on_failure:
+        if die_on_failure and not grv_only:
             self._empty_task = process.spawn(self._empty_batch_loop(),
                                              "emptyBatch")
         # admission control (transactionStarter :985 + getRate :86): a token
@@ -367,9 +414,7 @@ class Proxy:
         from foundationdb_tpu.utils.trace import TraceEvent
         TraceEvent("ProxyDied", self.process.address) \
             .detail("Reason", reason).detail("Epoch", self.epoch).log()
-        for token in (Token.PROXY_COMMIT, Token.PROXY_GET_READ_VERSION,
-                      Token.PROXY_GET_COMMITTED_VERSION, Token.PROXY_PING,
-                      Token.PROXY_METRICS):
+        for token in self._tokens:
             self.process.deregister(token)
         self.shutdown()
 
@@ -386,7 +431,8 @@ class Proxy:
         while True:
             await self.loop.delay(interval)
             if (self.loop.now() - self._last_flush >= interval
-                    and not self._pending and self._master_live()):
+                    and not self._pending and self._master_live()
+                    and self._inflight_batches < self._window()):
                 self._flush()
 
     # -- admission control --
@@ -522,27 +568,47 @@ class Proxy:
                 v, floor, self.process.address)
             reply.send(GetReadVersionReply(version=v))
             return
-        self.process.spawn(self._grv_confirm(reply, floor),
-                           "getLiveCommittedVersion")
+        self._confirm_waiters.append((reply, floor))
+        if not self._confirm_running:
+            self._confirm_running = True
+            self.process.spawn(self._grv_confirm_loop(),
+                               "getLiveCommittedVersion")
 
-    async def _grv_confirm(self, reply, floor: int = 0):
+    async def _grv_confirm_loop(self):
         """getLiveCommittedVersion (:935): a correct read version is >= every
-        commit any proxy has acknowledged, so take the max over all proxies."""
-        t0 = self.loop.now()
+        commit any proxy has acknowledged, so take the max over all proxies.
+        Rounds are COALESCED (GrvProxyServer's batched version fetch): one
+        peer round serves every GRV queued when it starts, so peer RPC
+        volume is O(rounds), not O(GRVs) x O(proxies) — at a few thousand
+        GRVs/s the per-request fan-out is what made multi-proxy topologies
+        pay for their second proxy. A GRV arriving mid-round waits for the
+        next round: its version must come from a fetch started after it
+        arrived, or acks landing during the round could be missed."""
         try:
-            others = await all_of([
-                self.process.net.request(self.process, ep, None)
-                for ep in self.other_proxies])
-            version = max([self.committed_version.get()] + others)
-            self.grv_bands.add(self.loop.now() - t0)
-            # external consistency oracle: >= every commit acked before the
-            # GRV arrived (debug_checkMinCommittedVersion)
-            sim_validation.of(
-                self.process.net, self.validation_scope).debug_check_read_version(
-                version, floor, self.process.address)
-            reply.send(GetReadVersionReply(version=version))
-        except FDBError as e:
-            reply.send_error(e)
+            while self._confirm_waiters:
+                waiters, self._confirm_waiters = self._confirm_waiters, []
+                t0 = self.loop.now()
+                try:
+                    others = await all_of([
+                        self.process.net.request(self.process, ep, None)
+                        for ep in self.other_proxies])
+                except FDBError as e:
+                    for reply, _ in waiters:
+                        reply.send_error(FDBError(e.name, e.detail))
+                    if e.name == "operation_cancelled":
+                        raise
+                    continue
+                version = max([self.committed_version.get()] + others)
+                self.grv_bands.add(self.loop.now() - t0)
+                # external consistency oracle: >= every commit acked before
+                # the GRV arrived (debug_checkMinCommittedVersion)
+                val = sim_validation.of(self.process.net, self.validation_scope)
+                for reply, floor in waiters:
+                    val.debug_check_read_version(version, floor,
+                                                 self.process.address)
+                    reply.send(GetReadVersionReply(version=version))
+        finally:
+            self._confirm_running = False
 
     # -- commit batching (queueTransactionStartRequests/batcher pattern) --
 
@@ -566,36 +632,103 @@ class Proxy:
                 "transaction_throttled",
                 f"{t.backoff:.6f} {t.begin.hex()} {t.end.hex()}"))
             return
+        now_t = self.loop.now()
+        # smoothed commits-in rate (the commitBatcher's lastBatchIntervalRate
+        # feedback, collapsed to an explicit EWMA over interarrival gaps so
+        # the adaptive interval is a pure function of sim-deterministic state)
+        dt = max(now_t - self._last_arrival, 1e-6)
+        self._last_arrival = now_t
+        alpha = KNOBS.COMMIT_BATCH_RATE_SMOOTHING
+        self._arrival_rate += alpha * (1.0 / dt - self._arrival_rate)
         if not self._pending:
-            self._assembly_t0 = self.loop.now()  # batch-assembly span start
-        self._pending.append((req, reply, self.loop.now()))
-        if len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX:
-            self._flush()
+            self._assembly_t0 = now_t  # batch-assembly span start
+        self._pending.append((req, reply, now_t))
+        self._pending_bytes += sum(len(m.param1) + len(m.param2)
+                                   for m in req.mutations)
+        if (len(self._pending) >= KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX
+                or self._pending_bytes
+                >= KNOBS.COMMIT_TRANSACTION_BATCH_BYTES_MIN):
+            self._try_flush()
         elif not self._batcher_armed:
             self._batcher_armed = True
             self.process.spawn(self._batch_timer(), "commitBatcher")
 
+    def _target_interval(self) -> float:
+        """Arrival-rate-keyed flush interval: light load flushes at
+        INTERVAL_MIN (latency), and the interval slides linearly toward
+        INTERVAL_MAX as the smoothed rate approaches RATE_SATURATION
+        (amortizing per-batch pipeline cost under heavy load). The rate
+        is keyed CLUSTER-wide (per-proxy rate x pool size): the
+        per-batch downstream cost (master version fetch, resolver
+        dispatch, tlog push) lands on shared singleton roles, so a proxy
+        in a pool of n seeing 1/n of the load must batch as if it saw
+        the whole cluster's — otherwise fan-out re-fragments batches and
+        the shared roles pay n-fold per-batch overhead. BENCH_r08's
+        fan-out collapse (2 proxies, 0.53x writes) was exactly this.
+        The CAP stays at INTERVAL_MAX regardless of pool size: clients
+        run closed-loop against an admission budget, so commit
+        throughput is in-flight/latency and a stretched flush wait is
+        repaid as lost throughput, not saved work (measured in r10)."""
+        lo = KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+        hi = KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX
+        if hi <= lo:
+            return lo
+        n = max(1, self.n_proxies)
+        sat = max(1e-9, KNOBS.COMMIT_BATCH_RATE_SATURATION)
+        return lo + (hi - lo) * min(1.0, n * self._arrival_rate / sat)
+
     async def _batch_timer(self):
-        await self.loop.delay(KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
+        await self.loop.delay(self._target_interval())
         self._batcher_armed = False
         if self._pending:
-            self._flush()
+            self._try_flush()
+
+    def _window(self) -> int:
+        # COMMIT_PIPELINE_DEPTH bounds concurrent version batches through
+        # the SHARED master→resolver→tlog pipeline, so it is divided across
+        # the commit-proxy pool: n proxies each running the full depth would
+        # run n x DEPTH interleaved batches downstream, and every extra
+        # concurrent batch is another version-order wait at the resolvers
+        # and tlogs.
+        return max(1, KNOBS.COMMIT_PIPELINE_DEPTH // max(1, self.n_proxies))
+
+    def _try_flush(self):
+        """Flush unless the pipeline window is full; a deferred flush is
+        re-attempted when the draining batch completes."""
+        if not self._pending:
+            return
+        if self._inflight_batches >= self._window():
+            self._flush_blocked = True
+            return
+        self._flush()
 
     def _flush(self):
         batch, self._pending = self._pending, []
+        self._pending_bytes = 0
+        self._flush_blocked = False
         self._batch_n += 1
+        self._inflight_batches += 1
         self._last_flush = self.loop.now()
         self._c_batches.increment()
         # the assembly span's begin time predates the batch id, so both
         # records are emitted here with explicit timestamps
         bid = f"b{self.proxy_id}.{self._batch_n}"
-        if batch and self._assembly_t0 is not None:
+        t_arrival = self._assembly_t0
+        if batch and t_arrival is not None:
             g_trace_batch.span_begin("CommitSpan", bid, "Proxy.BatchAssembly",
-                                     at=self._assembly_t0)
+                                     at=t_arrival)
             g_trace_batch.span_end("CommitSpan", bid, "Proxy.BatchAssembly",
                                    at=self._last_flush)
         self._assembly_t0 = None
-        self.process.spawn(self._commit_batch(self._batch_n, batch), "commitBatch")
+        self.process.spawn(
+            self._commit_batch(self._batch_n, batch, t_arrival), "commitBatch")
+
+    def _batch_done(self):
+        """Pipeline-window bookkeeping: a finished batch frees a slot and
+        drains any flush that deferred while the window was full."""
+        self._inflight_batches -= 1
+        if self._flush_blocked:
+            self._try_flush()
 
     def _band_replies(self, t_ins):
         """Record commit latency per request, from RECEIPT (including the
@@ -607,7 +740,8 @@ class Proxy:
 
     # -- the 5-phase pipeline --
 
-    async def _commit_batch(self, batch_n: int, batch):
+    async def _commit_batch(self, batch_n: int, batch,
+                            t_arrival: float | None = None):
         requests = [req for req, _rep, _t in batch]
         replies = [rep for _req, rep, _t in batch]
         t_ins = [t for _req, _rep, t in batch]
@@ -637,6 +771,15 @@ class Proxy:
         try:
             # ---- Phase 1: pre-resolution (:363) ----
             await self.latest_resolving.when_at_least(batch_n - 1)
+            # queueing made visible: arrival of the batch's first request →
+            # pipeline dispatch (batcher wait + window admission + resolving
+            # gate). Both records carry explicit timestamps, emitted here so
+            # a batch that never passes the gate emits no dangling begin.
+            if requests and t_arrival is not None:
+                g_trace_batch.span_begin("CommitSpan", bid,
+                                         "Proxy.QueueDelay", at=t_arrival)
+                g_trace_batch.span_end("CommitSpan", bid,
+                                       "Proxy.QueueDelay", at=now())
             _sb("Proxy.GetCommitVersion")
             self._request_num += 1
             # RETRY the version fetch with the SAME request_num until the
@@ -746,6 +889,11 @@ class Proxy:
 
             # ---- Phase 3: post-resolution (:425) ----
             await self.latest_logging.when_at_least(batch_n - 1)
+            # tag set BEFORE this batch's metadata lands: a keyServers
+            # change must also reach the tags it REMOVES (they fence
+            # themselves on it — see the broadcast in the routing loop),
+            # and those can be absent from the post-apply map
+            pre_move_tags = set(self.shards.all_tags())
             # FIRST: other proxies' metadata txns from the resolver replies,
             # in version order, global verdict = AND over all resolvers'
             # local verdicts (MasterProxyServer.actor.cpp:452-489). This must
@@ -814,6 +962,8 @@ class Proxy:
             tags_for_range = self.shards.tags_for_range
             tags_for_key = self.shards.tags_for_key
             backup_ranges = self.backup_ranges
+            ks_prefix = systemdata.KEY_SERVERS_PREFIX
+            ks_tags: list[int] | None = None  # built lazily (moves are rare)
             clear_t = MutationType.CLEAR_RANGE
             vs_key = MutationType.SET_VERSIONSTAMPED_KEY
             vs_val = MutationType.SET_VERSIONSTAMPED_VALUE
@@ -828,7 +978,20 @@ class Proxy:
                         m = self._substitute(m, stamp)
                         mt = m.type
                     mutation_bytes += len(m.param1) + len(m.param2)
-                    if mt == clear_t:
+                    if m.param1 >= sys_prefix and m.param1.startswith(ks_prefix):
+                        # keyServers changes BROADCAST to every storage tag,
+                        # old teams included (ApplyMetadataMutation's private
+                        # serverKeys mutations): each server sees the team
+                        # change in its OWN tag stream at the commit version,
+                        # so shard revocation is fenced by the version stream
+                        # itself instead of racing the DD layout push — the
+                        # race that let an old owner serve stale reads at
+                        # post-move versions (storage._apply_shard_private)
+                        if ks_tags is None:
+                            ks_tags = sorted(
+                                pre_move_tags.union(self.shards.all_tags()))
+                        tags = ks_tags
+                    elif mt == clear_t:
                         tags = tags_for_range(m.param1, m.param2)
                     else:
                         tags = tags_for_key(m.param1)
@@ -860,14 +1023,21 @@ class Proxy:
             # push through the log system: per-set quorum (primary
             # N - antiquorum, plus every satellite set's own quorum)
             _sb("Proxy.TLogPush")
-            await self.log_system.push(
+            push_f = self.log_system.push(
                 prev_version, commit_version, messages,
                 self.committed_version.get())
-            _se("Proxy.TLogPush")
-            # monotonic: a LATER batch that failed early (before its phase-3
-            # gate) already max-set this past batch_n in its except handler;
-            # a plain set would throw and abort this healthy batch
+            # release the logging gate at push INITIATION, not completion
+            # (the reference releases latestLocalCommitBatchLogging before
+            # waiting on the push, :426/:835): the TLogs order concurrent
+            # pushes on the prevVersion chain themselves and dedupe replays,
+            # so batch N+1 may route and push while this push is in flight —
+            # without this, every push serializes behind the previous one's
+            # network round trip and the batcher idles. Max-set because a
+            # LATER batch that failed early already max-set past batch_n in
+            # its except handler; a plain set would throw here.
             self.latest_logging.set(max(self.latest_logging.get(), batch_n))
+            await push_f
+            _se("Proxy.TLogPush")
 
             # ---- Phase 5: replies (:862) ----
             g_trace_batch.add_event(
@@ -933,6 +1103,8 @@ class Proxy:
                     # error). Plain data batches keep retry slack so a
                     # transient TLog blip doesn't churn generations.
                     self.die(f"commit pipeline failing: {detail}")
+        finally:
+            self._batch_done()
 
     def _substitute(self, m: Mutation, stamp: bytes) -> Mutation:
         if m.type == MutationType.SET_VERSIONSTAMPED_KEY:

@@ -33,6 +33,7 @@ from foundationdb_tpu.utils.types import Mutation, MutationType
 from foundationdb_tpu.utils import wire
 
 _DURABLE_VERSION_KEY = "durableVersion"
+_KS_PREFIX = b"\xff/keyServers/"
 _SSD_DIR: list[str] = []
 
 
@@ -80,6 +81,13 @@ class StorageServer:
         # mutations since (an exclusion drained it, then it was included
         # back) and must re-fetch; only a retry of the SAME move may skip
         self._shard_fences: dict = {}
+        # version-fenced revocations from keyServers private mutations seen
+        # in this server's OWN tag stream: (begin, end, version) means this
+        # server stopped owning [begin, end) at `version` — reads at read
+        # versions >= it get wrong_shard_server even though shard_ranges
+        # still lists the range (the authoritative SET_SHARDS push is a
+        # racing one-way message; the version stream is not)
+        self._revoked: list[tuple[bytes, bytes | None, int]] = []
         # engine selection (openKVStore dispatch IKeyValueStore.h:66,
         # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
         # WAL (kill-injected durability faults); "ssd" = host B-tree over
@@ -255,6 +263,23 @@ class StorageServer:
                 return
             self._layout_version = lv
         self.shard_ranges = [tuple(r) for r in req.shard_ranges]
+        # the authoritative layout has landed: a revocation whose range the
+        # layout no longer lists is now enforced by the ownership check
+        # itself, so drop it. Same for one fenced at/below as_of_version —
+        # this layout already accounts for that move (a revocation can
+        # over-cover: the server fences from its own coarse served range,
+        # not the moved shard's exact bounds, so the listed remainder must
+        # lift here or it would bounce reads forever). One fenced ABOVE
+        # as_of_version that still overlaps a listed range stays: that is
+        # a delayed stale push, and only a re-adding fetch (_add_shard,
+        # which re-copies the data) may lift a newer fence.
+        if self._revoked:
+            av = getattr(req, "as_of_version", None)
+            self._revoked = [
+                (b, e, v) for b, e, v in self._revoked
+                if (av is None or v > av)
+                and any((e is None or sb < e) and (se is None or b < se)
+                        for sb, se in self.shard_ranges)]
         reply.send(None)
 
     def _on_add_shard(self, req: AddShardRequest, reply):
@@ -358,6 +383,23 @@ class StorageServer:
                 self.shard_ranges = (self.shard_ranges or []) + [(req.begin,
                                                                   req.end)]
             self._shard_fences[(req.begin, req.end)] = req.fence_version
+            # the splice re-copied this range's data at c0, so any standing
+            # revocation is obsolete exactly over [begin, end) — a range
+            # that moved away and back must serve again, not bounce reads
+            # on the stale fence. Overlaps are NARROWED, not dropped: a
+            # remainder outside the fetch was not re-copied and stays fenced.
+            if self._revoked:
+                kept: list[tuple[bytes, bytes | None, int]] = []
+                for b, e, v in self._revoked:
+                    if ((e is not None and e <= req.begin)
+                            or (req.end is not None and b >= req.end)):
+                        kept.append((b, e, v))
+                        continue
+                    if b < req.begin:
+                        kept.append((b, req.begin, v))
+                    if req.end is not None and (e is None or req.end < e):
+                        kept.append((req.end, e, v))
+                self._revoked = kept
             reply.send(c0)
         except FDBError as e:
             reply.send_error(e)
@@ -405,6 +447,8 @@ class StorageServer:
                     break  # next iteration peeks the successor epoch
                 for m in muts:
                     self.data.apply(version, m)
+                    if m.param1 >= _KS_PREFIX:
+                        self._apply_shard_private(m, version)
                 self._c_mutations.increment(len(muts))
                 self._pending_durable.append((version, muts))
                 self._peek_begin = version
@@ -493,6 +537,42 @@ class StorageServer:
         return any(b <= key and (e is None or key < e)
                    for b, e in self.shard_ranges)
 
+    def _apply_shard_private(self, m: Mutation, version: int):
+        """A keyServers mutation arriving in this server's OWN tag stream
+        (the proxy broadcasts them to every storage tag — the reference's
+        private serverKeys mutations, ApplyMetadataMutation.h). If the new
+        team excludes this tag, the served range containing the shard point
+        is REVOKED from `version` on: any read at a read version >= it gets
+        wrong_shard_server instead of a quietly stale answer. The version
+        stream is the only race-free channel for this — mutations stop
+        flowing here at exactly the move's commit version, while the
+        authoritative SET_SHARDS layout push races in-flight reads. The
+        revocation is cleared when that push (or a re-adding fetch) lands."""
+        if (m.type != MutationType.SET_VALUE or self.shard_ranges is None
+                or not m.param1.startswith(_KS_PREFIX)):
+            return
+        from foundationdb_tpu.server import systemdata
+        if self.tag in systemdata.decode_tags(m.param2):
+            return
+        point = m.param1[len(_KS_PREFIX):]
+        for b, e in self.shard_ranges:
+            if b <= point and (e is None or point < e):
+                # only [point, e) moved: a split at `point` keeps [b, point)
+                # here, and fencing the kept half would bounce its reads
+                # until the layout push lands
+                self._revoked.append((max(b, point), e, version))
+
+    def _revoked_read(self, begin: bytes, end: bytes | None,
+                      version: int) -> bool:
+        """True when [begin, end) overlaps a range revoked at/below
+        `version` — the read must get wrong_shard_server (the client
+        re-resolves through the published layout and retries)."""
+        for b, e, v in self._revoked:
+            if (version >= v and (e is None or begin < e)
+                    and (end is None or b < end)):
+                return True
+        return False
+
     def _owns_range(self, begin: bytes, end: bytes) -> bool:
         """A request is in-shard when the UNION of contiguous served entries
         covers it — after a layout merge a client legitimately reads across
@@ -535,6 +615,9 @@ class StorageServer:
             if not self._owns_key(req.key):
                 raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
+            if self._revoked and self._revoked_read(
+                    req.key, req.key + b"\x00", req.version):
+                raise FDBError("wrong_shard_server")
             reply.send(GetValueReply(value=self.data.get(req.key, req.version),
                                      version=req.version))
         except FDBError as e:
@@ -573,7 +656,9 @@ class StorageServer:
         oldest = data.oldest_version
         out = []
         for k, v in req.reads:
-            if not self._owns_key(k):
+            if (not self._owns_key(k)
+                    or (self._revoked
+                        and self._revoked_read(k, k + b"\x00", v))):
                 out.append((1, "wrong_shard_server"))
             elif v < oldest:
                 out.append((1, "transaction_too_old"))
@@ -596,6 +681,9 @@ class StorageServer:
             if not self._owns_range(req.begin.key, req.end.key):
                 raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
+            if self._revoked and self._revoked_read(
+                    req.begin.key, req.end.key, req.version):
+                raise FDBError("wrong_shard_server")
             begin = self._resolve_selector(req.begin, req.version)
             end = self._resolve_selector(req.end, req.version)
             if end < begin:
@@ -628,6 +716,9 @@ class StorageServer:
             if not self._owns_key(req.key):
                 raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
+            if self._revoked and self._revoked_read(
+                    req.key, req.key + b"\x00", req.version):
+                raise FDBError("wrong_shard_server")
             current = self.data.get(req.key, self.version.get())
             if current != req.value:
                 reply.send(self.version.get())

@@ -101,6 +101,10 @@ class Worker:
             from foundationdb_tpu.server.proxy import Proxy
             self._set_role(f"proxy:{args['proxy_id']}",
                            Proxy(self.process, **args))
+        elif role == "grv_proxy":
+            from foundationdb_tpu.server.proxy import Proxy
+            self._set_role(f"proxy:{args['proxy_id']}",
+                           Proxy(self.process, grv_only=True, **args))
         elif role == "resolver":
             from foundationdb_tpu.server.resolver import Resolver
             self._set_role("resolver", Resolver(self.process, **args))

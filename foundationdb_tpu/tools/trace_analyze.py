@@ -110,6 +110,25 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[k]
 
 
+# the commit path's server-side stages, in pipeline order; their p50 sum is
+# the denominator of queueing_ratio (Proxy.QueueDelay is deliberately NOT a
+# member — it IS the queueing being measured)
+SERVER_STAGES = ("Proxy.BatchAssembly", "Proxy.GetCommitVersion",
+                 "Proxy.Resolve", "Proxy.TLogPush", "Proxy.Reply")
+
+
+def queueing_ratio(stages: dict) -> float | None:
+    """Client.Commit p50 over the summed p50s of the server-side commit
+    stages: ~1 means end-to-end latency is explained by work, large values
+    mean the commit spent its life waiting in queues (BENCH_r08 was ~9x).
+    None when the trace carries no client or no server commit spans."""
+    client = stages.get("Client.Commit")
+    server = sum(stages[s]["p50"] for s in SERVER_STAGES if s in stages)
+    if not client or server <= 0.0:
+        return None
+    return round(client["p50"] / server, 2)
+
+
 def stage_stats(spans) -> dict:
     """Per-stage residency: {span_name: {n, p50, p99, total}} seconds."""
     by_stage: dict[str, list[float]] = {}
@@ -149,6 +168,22 @@ def check_well_formed(events) -> list[str]:
     for s in spans:
         if s["End"] < s["Start"]:
             problems.append(f"span ends before it starts: {s['Span']} "
+                            f"id={s['ID']}")
+    # Proxy.QueueDelay covers arrival -> batch dispatch: on any ident that
+    # also carries the batch's GetCommitVersion span, the queue delay must
+    # have ENDED by the time the version fetch starts (equal timestamps ok)
+    gcv_start: dict[str, float] = {}
+    for s in spans:
+        if s["Span"] == "Proxy.GetCommitVersion":
+            prev = gcv_start.get(s["ID"])
+            gcv_start[s["ID"]] = s["Start"] if prev is None \
+                else min(prev, s["Start"])
+    for s in spans:
+        if s["Span"] != "Proxy.QueueDelay":
+            continue
+        start = gcv_start.get(s["ID"])
+        if start is not None and s["End"] > start + 1e-6:
+            problems.append(f"queue delay overlaps version fetch: "
                             f"id={s['ID']}")
     ids_with_spans = {s["ID"] for s in spans}
     for ev in events:
@@ -197,12 +232,14 @@ def contention_stats(events) -> dict:
 def analyze(events) -> dict:
     spans, unmatched = pair_spans(events)
     flows = transaction_timelines(events)
+    stages = stage_stats(spans)
     return {
         "events": len(events),
         "spans": len(spans),
         "unmatched": len(unmatched),
         "flows": len(flows),
-        "stages": stage_stats(spans),
+        "stages": stages,
+        "queueing_ratio": queueing_ratio(stages),
         "contention": contention_stats(events),
     }
 
@@ -215,6 +252,10 @@ def format_report(report: dict) -> str:
     for stage, st in report["stages"].items():
         lines.append(f"{stage:<28} {st['n']:>7} {st['p50']:>10.6f} "
                      f"{st['p99']:>10.6f} {st['total']:>10.3f}")
+    qr = report.get("queueing_ratio")
+    if qr is not None:
+        lines.append(f"queueing_ratio (Client.Commit p50 / server stages "
+                     f"p50 sum): {qr:.2f}")
     con = report.get("contention")
     if con and con["commits_in"]:
         lines.append(

@@ -17,7 +17,7 @@ from foundationdb_tpu.bindings import bindingtester, fdb_c
 
 @pytest.fixture
 def real_cluster(tmp_path):
-    procs, p_proxies, boundaries, p_storages = bench_e2e._boot_cluster(
+    procs, p_proxies, boundaries, p_storages, _grv = bench_e2e._boot_cluster(
         str(tmp_path), "oracle", n_proxies=0, n_storage=1)
     yield p_proxies, boundaries, p_storages
     for p in procs:

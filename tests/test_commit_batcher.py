@@ -1,0 +1,330 @@
+"""Commit batcher behavior: flush triggers (count / bytes / interval), the
+arrival-rate-adaptive interval, the bounded multi-batch pipeline window, the
+empty-batch keepalive, deterministic batch numbering under sim, and the
+client's AIMD commit admission control.
+
+Reference: MasterProxyServer.actor.cpp commitBatcher (COMMIT_TRANSACTION_
+BATCH_* knobs) and GrvProxyServer's transaction budget; the pipelined
+version-batch window is the reference's overlapping commitBatch actors
+ordered by NotifiedVersion waits.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.core.future import Future
+from foundationdb_tpu.server.cluster import RecoverableCluster, SimCluster
+from foundationdb_tpu.utils import trace as T
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+
+
+@pytest.fixture(autouse=True)
+def _knobs():
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    yield
+    KNOBS.reset()
+
+
+def _pump(cluster, dt: float = 0.001):
+    """Run the sim loop briefly so spawned background actors start (a
+    constructed-but-never-run cluster leaves them as unawaited coroutines)."""
+    async def idle():
+        await cluster.loop.delay(dt)
+    cluster.run_all([idle()], max_time=10.0)
+
+
+def _commit_n(cluster, db, n, max_time=600.0, prefix=b"cb"):
+    async def one(i):
+        tr = db.create_transaction()
+        tr.set(b"%s%04d" % (prefix, i), b"v" * 8)
+        await tr.commit()
+    cluster.run_all([one(i) for i in range(n)], max_time=max_time)
+
+
+# ------------------------------------------------------------ flush triggers
+
+def test_count_trigger_flushes_before_interval():
+    """COUNT_MAX reached -> the batch dispatches immediately; with the
+    interval knobs set far beyond the test horizon, only the count trigger
+    can explain the commits completing."""
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 4)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 30.0)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 30.0)
+    c = SimCluster(seed=3, n_proxies=1)
+    db = c.database()
+    t0 = c.loop.now()
+    _commit_n(c, db, 8, max_time=20.0)
+    assert c.loop.now() - t0 < 20.0
+    assert c.proxies[0]._c_batches.value >= 2
+
+
+def test_bytes_trigger_flushes_before_interval():
+    """BATCH_BYTES_MIN reached -> immediate dispatch, same horizon logic."""
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 64)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 30.0)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 30.0)
+    c = SimCluster(seed=4, n_proxies=1)
+    db = c.database()
+
+    async def big():
+        tr = db.create_transaction()
+        tr.set(b"bigkey", b"x" * 200)  # alone exceeds BYTES_MIN
+        await tr.commit()
+    t0 = c.loop.now()
+    c.run_all([big()], max_time=20.0)
+    assert c.loop.now() - t0 < 20.0
+
+
+def test_interval_trigger_flushes_lone_commit():
+    """A single small commit (neither count nor bytes trigger) still
+    dispatches after the batch interval."""
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 10_000)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_BYTES_MIN", 1 << 30)
+    c = SimCluster(seed=5, n_proxies=1)
+    db = c.database()
+    _commit_n(c, db, 1, max_time=60.0)
+    assert c.proxies[0].stats["committed"] == 1
+
+
+# ------------------------------------------------------- adaptive interval
+
+def test_target_interval_slides_with_arrival_rate():
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.010)
+    KNOBS.set("COMMIT_BATCH_RATE_SATURATION", 1000.0)
+    c = SimCluster(seed=6, n_proxies=1)
+    _pump(c)  # start the roles' background actors
+    px = c.proxies[0]
+    px._arrival_rate = 0.0
+    assert px._target_interval() == pytest.approx(0.001)
+    px._arrival_rate = 500.0  # half of saturation -> mid interval
+    assert px._target_interval() == pytest.approx(0.0055)
+    px._arrival_rate = 5000.0  # beyond saturation clamps at MAX
+    assert px._target_interval() == pytest.approx(0.010)
+    # degenerate config (MAX <= MIN) pins to MIN instead of inverting
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.0005)
+    assert px._target_interval() == pytest.approx(0.001)
+
+
+def test_target_interval_scales_with_proxy_pool():
+    """The saturation rate is cluster-wide: a proxy in a pool of n sees
+    1/n of the commit rate but batches as if it saw all of it, so
+    fan-out does not re-fragment batches through the shared
+    master/resolvers/tlogs. The cap stays at INTERVAL_MAX — stretching
+    the flush wait past it just converts closed-loop client throughput
+    into idle queueing."""
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001)
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.010)
+    KNOBS.set("COMMIT_BATCH_RATE_SATURATION", 1000.0)
+    c = SimCluster(seed=14, n_proxies=2)
+    _pump(c)
+    px = c.proxies[0]
+    px._arrival_rate = 0.0  # light load: latency wins regardless of pool
+    assert px._target_interval() == pytest.approx(0.001)
+    # each of 2 proxies at 250/s == half of cluster saturation: the pool
+    # sits at the same mid-curve point a lone proxy at 500/s would
+    px._arrival_rate = 250.0
+    assert px._target_interval() == pytest.approx(0.0055)
+    # cluster saturation (2 x 500/s) clamps at MAX, never n x MAX
+    px._arrival_rate = 500.0
+    assert px._target_interval() == pytest.approx(0.010)
+
+
+def test_arrival_rate_ewma_rises_under_load():
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 4)
+    c = SimCluster(seed=7, n_proxies=1)
+    db = c.database()
+    _commit_n(c, db, 40)
+    assert c.proxies[0]._arrival_rate > 0.0
+
+
+# ------------------------------------------------------------ pipeline window
+
+def test_inflight_batches_bounded_by_pipeline_depth():
+    """With many batches forced (COUNT_MAX=1) the number of concurrently
+    in-flight version batches never exceeds COMMIT_PIPELINE_DEPTH, and the
+    pipeline actually overlaps batches (depth observed > 1)."""
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 1)
+    KNOBS.set("COMMIT_PIPELINE_DEPTH", 2)
+    c = SimCluster(seed=8, n_proxies=1)
+    px = c.proxies[0]
+    seen: list[int] = []
+    orig = px._flush
+
+    def spy():
+        orig()
+        seen.append(px._inflight_batches)
+    px._flush = spy
+    db = c.database()
+    _commit_n(c, db, 30)
+    assert seen and max(seen) <= 2
+    assert max(seen) > 1, "pipeline never overlapped two batches"
+    assert px._inflight_batches == 0  # every batch released its slot
+
+
+def test_depth_one_serializes_batches():
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 1)
+    KNOBS.set("COMMIT_PIPELINE_DEPTH", 1)
+    c = SimCluster(seed=9, n_proxies=1)
+    px = c.proxies[0]
+    seen: list[int] = []
+    orig = px._flush
+
+    def spy():
+        orig()
+        seen.append(px._inflight_batches)
+    px._flush = spy
+    db = c.database()
+    _commit_n(c, db, 12)
+    assert seen and max(seen) == 1
+    assert px._inflight_batches == 0
+
+
+# -------------------------------------------------------- empty-batch keepalive
+
+def test_empty_batch_keepalive_advances_committed_version():
+    """An idle proxy still pushes empty batches every IDLE_INTERVAL so
+    storage servers' version horizon (and GRV recency) keeps moving."""
+    c = SimCluster(seed=10, n_proxies=1)
+    px = c.proxies[0]
+    # statically-built sim proxies don't start the keepalive (it exists for
+    # recruited clusters whose storage horizon must keep moving); start it
+    # here to test the loop itself
+    px._empty_task = px.process.spawn(px._empty_batch_loop(), "emptyBatch")
+
+    async def idle():
+        await c.loop.delay(5 * KNOBS.COMMIT_BATCH_IDLE_INTERVAL)
+    c.run_all([idle()], max_time=60.0)
+    assert px.committed_version.get() > 0
+    assert px.stats["commits_in"] == 0
+
+
+# ------------------------------------------------- deterministic numbering
+
+def _batch_ids(seed: int) -> list[str]:
+    got: list[dict] = []
+    KNOBS.set("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 2)
+    KNOBS.set("COMMIT_PIPELINE_DEPTH", 4)
+    T.g_trace_batch._events.clear()  # drop other tests' buffered records
+    try:
+        T.set_sink(got.append)
+        c = SimCluster(seed=seed, n_proxies=2)
+        db = c.database()
+        _commit_n(c, db, 24)
+        T.g_trace_batch.dump()
+    finally:
+        T.set_sink(None)
+        T.g_trace_batch._events.clear()
+    return [e["ID"] for e in got
+            if e.get("Span") == "Proxy.BatchAssembly"
+            and e.get("Phase") == "Begin"]
+
+
+def test_batch_numbering_deterministic_with_pipelining():
+    """Same seed => identical batch-id sequence even with a >1 pipeline
+    window (batch numbers are assigned at flush, not at completion)."""
+    a = _batch_ids(seed=21)
+    b = _batch_ids(seed=21)
+    assert a and a == b
+    # distinct per-proxy monotonic numbering, no reuse
+    assert len(a) == len(set(a))
+
+
+# ------------------------------------------------------ client admission
+
+def test_admission_bounds_in_flight_commits():
+    KNOBS.set("CLIENT_COMMIT_INITIAL_IN_FLIGHT", 3)
+    KNOBS.set("CLIENT_COMMIT_MAX_IN_FLIGHT", 3)
+    c = SimCluster(seed=12, n_proxies=1)
+    db = c.database()
+    peak = [0]
+    done = [0]
+
+    async def monitor():
+        while done[0] < 20:
+            peak[0] = max(peak[0], db._commits_in_flight)
+            await c.loop.delay(0.0002)
+
+    async def one(i):
+        tr = db.create_transaction()
+        tr.set(b"adm%04d" % i, b"v")
+        await tr.commit()
+        done[0] += 1
+    c.run_all([monitor()] + [one(i) for i in range(20)], max_time=600.0)
+    assert peak[0] <= 3
+    assert db._commits_in_flight == 0 and not db._commit_queue
+
+
+def test_admission_feedback_aimd():
+    c = SimCluster(seed=13, n_proxies=1)
+    _pump(c)
+    db = c.database()
+    db._commit_budget = 8.0
+
+    ok = Future()
+    ok._set(object())
+    # healthy acks: additive increase, bounded by MAX
+    db._admission_feedback(ok, 0.010)
+    assert db._commit_budget > 8.0
+    db._commit_budget = float(KNOBS.CLIENT_COMMIT_MAX_IN_FLIGHT)
+    db._admission_feedback(ok, 0.010)
+    assert db._commit_budget == float(KNOBS.CLIENT_COMMIT_MAX_IN_FLIGHT)
+
+    # throttle signal: multiplicative cut, floored at 1
+    db._commit_budget = 10.0
+    throttled = Future()
+    throttled._set_error(FDBError("transaction_throttled", "0.1 00 ff"))
+    db._admission_feedback(throttled, 0.001)
+    assert db._commit_budget == pytest.approx(
+        10.0 * KNOBS.CLIENT_ADMISSION_DECREASE)
+    # a second cut inside the same window is suppressed (one cut per event)
+    db._admission_feedback(throttled, 0.001)
+    assert db._commit_budget == pytest.approx(
+        10.0 * KNOBS.CLIENT_ADMISSION_DECREASE)
+
+    # latency inflation vs the learned floor also cuts
+    db2 = c.database("client:aimd2")
+    db2._commit_budget = 10.0
+    db2._admission_feedback(ok, 0.010)  # learn the floor
+    assert db2._commit_lat_floor == pytest.approx(0.010)
+    db2._admission_feedback(
+        ok, 0.010 * (KNOBS.CLIENT_ADMISSION_LATENCY_RATIO + 1))
+    assert db2._commit_budget < 10.0
+
+    # conflicts say nothing about queueing: budget untouched
+    db3 = c.database("client:aimd3")
+    db3._commit_budget = 10.0
+    conflict = Future()
+    conflict._set_error(FDBError("not_committed"))
+    db3._admission_feedback(conflict, 0.010)
+    assert db3._commit_budget == 10.0
+
+
+# ------------------------------------------------- grv/commit proxy split
+
+def test_grv_split_recruited_and_routed():
+    """The CC recruits dedicated GRV proxies on their own workers, the
+    DBInfo publishes them, and a refreshed client routes read versions to
+    the GRV pool while commits stay on the commit pool."""
+    c = RecoverableCluster(seed=11, n_workers=5, n_proxies=2,
+                           n_grv_proxies=1, n_resolvers=1, n_tlogs=2,
+                           n_storage=2)
+    db = c.database()
+
+    async def work():
+        await db.refresh(max_wait=300.0)
+        assert db.grv_proxies, "grv pool empty after refresh"
+        assert not set(db.grv_proxies) & set(db.proxies), \
+            "grv proxy co-listed in the commit pool"
+
+        async def fn(tr):
+            tr.set(b"split", b"1")
+        await db.transact(fn, max_retries=50)
+        tr = db.create_transaction()
+        assert await tr.get(b"split") == b"1"
+        status = await db.get_status()
+        roles = [e["role"] for e in status["cluster"]["roles"]]
+        assert "grv_proxy" in roles
+    c.run(c.loop.spawn(work(), "work"), max_time=60_000.0)

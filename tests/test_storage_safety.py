@@ -176,6 +176,134 @@ def test_fetchkeys_discards_in_flight_peek():
     loop.run_future(loop.spawn(t()), max_time=600.0)
 
 
+def test_keyservers_private_mutation_fences_moved_shard():
+    """Regression for the version-unfenced shard handoff: DD's final
+    metadata commit reroutes a moved range's writes to the new team, but the
+    old owner only learns of the move from a one-way SET_SHARDS push — and
+    its version keeps advancing past the move through empty peek ranges, so
+    `_wait_for_version` passes and it serves STALE values at post-move read
+    versions (the seed-3 serializability violation). The proxy now
+    broadcasts keyServers mutations to every storage tag (the reference's
+    private serverKeys mutations, ApplyMetadataMutation.h): the old owner
+    sees the move in its OWN stream at the commit version and fences the
+    range from that version on, until a re-adding fetch re-copies the data.
+    """
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.server import systemdata
+    from foundationdb_tpu.utils.errors import FDBError
+
+    # wide MVCC window so pre-move read versions stay readable
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 1000)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"a%03d" % v, b"v%03d" % v)]) for v in range(1, 30)]
+    # v=30: DD moves [a, b) to tag 1 — the keyServers change arrives in
+    # THIS server's (tag 0) stream via the proxy broadcast. No further
+    # messages: the log's `end` advances the version the same way the
+    # live cluster's empty peek ranges did.
+    msgs.append((30, [_set(systemdata.keyservers_key(b"a"),
+                           systemdata.encode_tags([1]))]))
+    ScriptedTLog(tlog_proc, msgs, end=51, kc=50)
+
+    src_proc = net.new_process("src:0")
+    rows = [(b"a%03d" % v, b"fresh%03d" % v) for v in range(1, 6)]
+
+    def on_get_kv(req, reply):
+        reply.send(GetKeyValuesReply(data=list(rows), more=False,
+                                     version=req.version))
+    src_proc.register(Token.STORAGE_GET_KEY_VALUES, on_get_kv)
+
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"],
+                       shard_ranges=[(b"a", b"b")])
+    client = net.new_process("client:0")
+
+    async def rd(key, version):
+        from foundationdb_tpu.server.interfaces import GetValueRequest
+        return await net.request(
+            client, Endpoint("ss:0", Token.STORAGE_GET_VALUE),
+            GetValueRequest(key=key, version=version))
+
+    async def t():
+        await loop.delay(2.0)
+        assert ss.version.get() == 50  # advanced PAST the move version
+        # pre-move read versions still serve (MVCC history is intact)
+        assert (await rd(b"a010", 25)).value == b"v010"
+        # post-move read versions bounce instead of serving stale data,
+        # even though shard_ranges still lists the range
+        for rv in (30, 40, 50):
+            with pytest.raises(FDBError) as ei:
+                await rd(b"a010", rv)
+            assert ei.value.name == "wrong_shard_server", rv
+        # the range moves BACK: the fetch re-copies the data at c0 and
+        # lifts the fence — reads serve the fresh copy again
+        c0 = await net.request(
+            client, Endpoint("ss:0", Token.STORAGE_ADD_SHARD),
+            AddShardRequest(begin=b"a", end=b"b", source="src:0",
+                            fence_version=45))
+        assert c0 == 50, c0
+        assert (await rd(b"a003", 50)).value == b"fresh003"
+        assert ss._revoked == [], ss._revoked
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+def test_set_shards_prunes_unlisted_revocations():
+    """The authoritative layout push drops revocations for ranges it no
+    longer lists (the ownership check enforces those from then on), keeping
+    the fence list bounded across repeated moves."""
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.server import systemdata
+    from foundationdb_tpu.server.interfaces import SetShardsRequest
+    from foundationdb_tpu.utils.errors import FDBError
+
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop, net = _harness()
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"a%03d" % v, b"v")]) for v in range(1, 20)]
+    msgs.append((20, [_set(systemdata.keyservers_key(b"a"),
+                           systemdata.encode_tags([1]))]))
+    ScriptedTLog(tlog_proc, msgs, end=31, kc=30)
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"],
+                       shard_ranges=[(b"a", b"b"), (b"c", b"d")])
+    client = net.new_process("client:0")
+
+    async def t():
+        await loop.delay(2.0)
+        assert ss._revoked == [(b"a", b"b", 20)], ss._revoked
+        # the push removes [a, b) from this server's layout: the revocation
+        # is pruned and the ownership check takes over
+        await net.request(
+            client, Endpoint("ss:0", Token.STORAGE_SET_SHARDS),
+            SetShardsRequest(shard_ranges=[(b"c", b"d")]))
+        assert ss._revoked == [], ss._revoked
+        from foundationdb_tpu.server.interfaces import GetValueRequest
+        with pytest.raises(FDBError) as ei:
+            await net.request(
+                client, Endpoint("ss:0", Token.STORAGE_GET_VALUE),
+                GetValueRequest(key=b"a010", version=25))
+        assert ei.value.name == "wrong_shard_server"
+
+        # a fence can OVER-cover (the server revokes from its coarse served
+        # range, not the moved shard's exact bounds): the push lifts fences
+        # at/below its as_of_version — that layout accounts for the move —
+        # but a delayed STALE push (older as_of_version) must not lift a
+        # newer fence even when it lists the range
+        async def push(av):
+            await net.request(
+                client, Endpoint("ss:0", Token.STORAGE_SET_SHARDS),
+                SetShardsRequest(shard_ranges=[(b"c", b"d")],
+                                 as_of_version=av))
+        ss._revoked = [(b"c", b"d", 20)]
+        await push(19)
+        assert ss._revoked == [(b"c", b"d", 20)], ss._revoked
+        await push(20)
+        assert ss._revoked == [], ss._revoked
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
 def test_cursor_mid_retry_observes_new_epochs():
     """VERDICT r4 regression: a recovery that installs a new epoch list while
     PeekCursor.get_more() is mid-retry against a dead TLog must be observed

@@ -104,6 +104,33 @@ def test_check_well_formed_catches_violations():
     assert any("dangling attach" in p for p in TA.check_well_formed(dangling))
 
 
+def test_check_well_formed_queue_delay_vs_version_fetch():
+    # the queue-delay span covers arrival -> batch dispatch, so it must end
+    # by the time the same batch's commit-version fetch begins
+    ok = (_mk("b0.1", "Proxy.QueueDelay", 0.0, 1.0)
+          + _mk("b0.1", "Proxy.GetCommitVersion", 1.0, 1.5))
+    assert TA.check_well_formed(ok) == []
+    bad = (_mk("b0.2", "Proxy.QueueDelay", 0.0, 1.2)
+           + _mk("b0.2", "Proxy.GetCommitVersion", 1.0, 1.5))
+    assert any("queue delay overlaps" in p
+               for p in TA.check_well_formed(bad))
+
+
+def test_queueing_ratio_rollup():
+    events = (_mk("c1", "Client.Commit", 0.0, 0.09)
+              + _mk("b0.1", "Proxy.GetCommitVersion", 0.0, 0.01)
+              + _mk("b0.1", "Proxy.Resolve", 0.01, 0.02)
+              + _mk("b0.1", "Proxy.TLogPush", 0.02, 0.04))
+    rep = TA.analyze(events)
+    # 0.09 client / (0.01 + 0.01 + 0.02) server
+    assert rep["queueing_ratio"] == pytest.approx(2.25)
+    # Proxy.QueueDelay must NOT enter the denominator: it IS the queueing
+    rep2 = TA.analyze(events + _mk("b0.1", "Proxy.QueueDelay", 0.0, 5.0))
+    assert rep2["queueing_ratio"] == pytest.approx(2.25)
+    # no client spans -> no ratio
+    assert TA.analyze(events[2:])["queueing_ratio"] is None
+
+
 def test_load_events_skips_torn_lines(tmp_path):
     p = tmp_path / "trace.jsonl"
     p.write_text('{"Type": "CommitSpan", "ID": "x"}\n'
@@ -117,8 +144,8 @@ def test_load_events_skips_torn_lines(tmp_path):
 
 EXPECTED_STAGES = {
     "Client.GRV", "Client.Commit", "Proxy.BatchAssembly",
-    "Proxy.GetCommitVersion", "Proxy.Resolve", "Proxy.TLogPush",
-    "Proxy.Reply", "Resolver.Dispatch", "TLog.Commit",
+    "Proxy.QueueDelay", "Proxy.GetCommitVersion", "Proxy.Resolve",
+    "Proxy.TLogPush", "Proxy.Reply", "Resolver.Dispatch", "TLog.Commit",
 }
 
 
