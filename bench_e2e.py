@@ -200,6 +200,10 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
         storage_specs.append({
             "listen": addr,
             "data_dir": os.path.join(tmp, f"storage{t}"),
+            # storage processes need the engine knobs too (STORAGE_ENGINE,
+            # REDWOOD_*) — without this an engine override in extra_knobs
+            # silently reached only the txn subsystem
+            "knobs": dict(extra_knobs or {}),
             "roles": [{"role": "storage",
                        "args": {"tag": t, "tlog_addrs": [p_core]}}],
         })
@@ -603,12 +607,162 @@ def run_contended_pair(backend: str = "oracle", clients: int = 1500,
     return out
 
 
+def _open_engine(engine: str, base: str):
+    """One engine instance over real files under `base` (transport
+    _LocalFile: fsync + pread, the production file surface)."""
+    from foundationdb_tpu.net.transport import _LocalFile
+    from foundationdb_tpu.storage.kvstore import open_kv_store
+    if engine == "memory":
+        return open_kv_store("memory",
+                             file0=_LocalFile(os.path.join(base, "wal.0")),
+                             file1=_LocalFile(os.path.join(base, "wal.1")))
+    if engine == "ssd":
+        return open_kv_store("ssd", path=os.path.join(base, "kv.sqlite"))
+    return open_kv_store(
+        "redwood",
+        file0=_LocalFile(os.path.join(base, "wal.0")),
+        file1=_LocalFile(os.path.join(base, "wal.1")),
+        open_file=lambda name: _LocalFile(os.path.join(base, name)),
+        existing_files=lambda: [n for n in os.listdir(base)
+                                if n.startswith("rw.")])
+
+
+def _engine_rows(n_keys: int, value_bytes: int, memtable_bytes: int) -> dict:
+    """Load one dataset (>= 10x the redwood memtable budget) into each
+    engine over real files, then time recovery from disk and cold reads
+    from the freshly recovered instance."""
+    from foundationdb_tpu.utils.knobs import KNOBS
+    from foundationdb_tpu.utils.rng import DeterministicRandom
+    KNOBS.set("REDWOOD_MEMTABLE_BYTES", memtable_bytes)
+    keys = [b"b%07d" % i for i in range(n_keys)]
+    value = b"v" * value_bytes
+    order = list(range(n_keys))
+    DeterministicRandom(99).shuffle(order)
+    out: dict = {"dataset_bytes": n_keys * (8 + value_bytes),
+                 "n_keys": n_keys,
+                 "redwood_memtable_bytes": memtable_bytes}
+    for engine in ("memory", "ssd", "redwood"):
+        base = tempfile.mkdtemp(prefix=f"fdbtpu-bench-{engine}-")
+        store = _open_engine(engine, base)
+        t0 = time.monotonic()
+        for i, k in enumerate(keys):
+            store.set(k, value)
+            if (i + 1) % 1000 == 0:
+                store.commit()
+                if engine == "redwood":
+                    store.maintain()
+        store.commit()
+        if engine == "redwood":
+            store.maintain()
+        load_s = time.monotonic() - t0
+        shape = store.level_shape() if engine == "redwood" else None
+        if engine == "ssd":
+            store.db.close()
+        del store
+        t0 = time.monotonic()
+        store2 = _open_engine(engine, base)
+        store2.recover()
+        assert store2.get(keys[0]) == value
+        recover_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        for i in order:
+            assert store2.get(keys[i]) is not None
+        cold_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        n = len(store2.get_range(b"", b"\xff" * 8))
+        scan_s = time.monotonic() - t0
+        assert n == n_keys, (engine, n)
+        if engine == "ssd":
+            store2.db.close()
+        row = {"load_seconds": round(load_s, 3),
+               "recover_seconds": round(recover_s, 4),
+               "cold_point_reads_per_sec": round(n_keys / cold_s, 1),
+               "cold_scan_keys_per_sec": round(n_keys / scan_s, 1)}
+        if shape is not None:
+            row["level_shape"] = {str(k): v for k, v in shape.items()}
+        out[engine] = row
+    return out
+
+
+def _cluster_restart_rows(n_keys: int = 1200, value_bytes: int = 40) -> dict:
+    """Whole-cluster restart per engine (deterministic sim, the
+    tests/test_restarting.py scenario): load, pull the plug on every
+    process at once, and time until a transaction commits again. sim
+    seconds are the cluster's own clock (deterministic); wall seconds are
+    the host cost of re-parsing runs / replaying WALs / re-recovering."""
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    from foundationdb_tpu.utils.errors import FDBError
+    from foundationdb_tpu.utils.knobs import KNOBS
+    out: dict = {"n_keys": n_keys, "value_bytes": value_bytes,
+                 "redwood_memtable_bytes": 4096}
+    for engine in ("memory", "ssd", "redwood"):
+        KNOBS.reset()
+        KNOBS.set("CONFLICT_BACKEND", "oracle")
+        KNOBS.set("STORAGE_ENGINE", engine)
+        KNOBS.set("SSD_DATA_DIR", tempfile.mkdtemp(prefix="fdbtpu-bench-rs-"))
+        # dataset ~n_keys*value_bytes >= 10x this budget: the restart
+        # recovers run files + WAL tail, not just a WAL
+        KNOBS.set("REDWOOD_MEMTABLE_BYTES", 4096)
+        KNOBS.set("REDWOOD_BLOCK_BYTES", 512)
+        KNOBS.set("REDWOOD_COMPACTION_FAN_IN", 2)
+        c = RecoverableCluster(seed=4242, n_workers=5, n_proxies=2,
+                               n_tlogs=2, n_storage=2, n_replicas=1)
+        db = c.database()
+        timings: dict = {}
+
+        async def scenario(c=c, db=db, timings=timings):
+            await db.refresh(max_wait=120.0)
+            value = b"r" * value_bytes
+            for base_i in range(0, n_keys, 20):
+                tr = db.create_transaction()
+                for i in range(base_i, min(base_i + 20, n_keys)):
+                    tr.set(b"rk%06d" % i, value)
+                await tr.commit()
+            from foundationdb_tpu.testing.workloads import quiet_database
+            await quiet_database(c, db)
+            sim0, wall0 = c.loop.now(), time.monotonic()
+            c.restart_from_disk()
+            while True:
+                if c.current_cc() is not None:
+                    try:
+                        async def probe(tr):
+                            await tr.get(b"rk000000")
+                        await db.transact(probe, max_retries=50)
+                        break
+                    except FDBError:
+                        pass
+                await c.loop.delay(0.25)
+            timings["sim_seconds"] = round(c.loop.now() - sim0, 2)
+            timings["wall_seconds"] = round(time.monotonic() - wall0, 3)
+            tr = db.create_transaction()
+            assert await tr.get(b"rk%06d" % (n_keys - 1)) == value
+
+        c.run(c.loop.spawn(scenario()), max_time=600_000.0)
+        KNOBS.reset()
+        out[engine] = timings
+    return out
+
+
+def run_storage_engines() -> dict:
+    """The storage-engine comparison rows for BENCH_r11: cold-read
+    throughput and recovery cost per engine on a dataset >= 10x the redwood
+    memtable budget, plus whole-cluster restart recovery per engine."""
+    return {
+        "engine_files": _engine_rows(n_keys=20_000, value_bytes=128,
+                                     memtable_bytes=256_000),
+        "cluster_restart": _cluster_restart_rows(),
+    }
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(json.loads(sys.argv[2]))
         sys.exit(0)
     if "--contended" in sys.argv:
         print(json.dumps(run_contended_pair(), indent=2))
+        sys.exit(0)
+    if "--storage-engines" in sys.argv:
+        print(json.dumps(run_storage_engines(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

@@ -85,6 +85,191 @@ static PyObject *py_crc32c(PyObject *self, PyObject *args) {
 }
 
 /* ------------------------------------------------------------------ */
+/* Redwood block codec                                                 */
+/* ------------------------------------------------------------------ */
+
+/* On-disk structs of the redwood storage engine (storage/redwood.py is the
+ * binding authority; the PROTO005-style parity test in tests/test_redwood.py
+ * cross-checks these comments against the Python field lists):
+ *
+ *   RedwoodBlockHeader { magic: u32, n_entries: u32, payload_bytes: u32, crc: u32 }
+ *   RedwoodBlockEntry { shared: u16, suffix_len: u16, value_len: u32 }
+ *   RedwoodRunHeader { magic: u32, format_version: u32, run_id: u64, meta_seq: u64, level: u32, n_blocks: u32, n_sources: u32, index_bytes: u32, aux_bytes: u32, body_crc: u32 }
+ *   RedwoodRunIndexEntry { offset: u32, length: u32, last_key_len: u16 }
+ *
+ * All fields little-endian. The block payload is a sequence of entries,
+ * each RedwoodBlockEntry header + key suffix + value, keys prefix-
+ * compressed against the previous key in the block; crc is CRC-32C over
+ * the payload. Only the block codec lives in C (the hot path: every flush,
+ * compaction, and cold read crosses it); run-file assembly stays in Python
+ * on both paths, so there is exactly one orchestration to keep correct.
+ * The Python fallback (storage/redwood.py py_encode_block/py_decode_block)
+ * must produce bit-identical bytes — the parity fuzz is the gate. */
+
+#define REDWOOD_BLOCK_MAGIC 0x5EDB10C5u
+
+static PyObject *py_redwood_encode_block(PyObject *self, PyObject *arg) {
+    PyObject *seq = PySequence_Fast(arg, "expected a sequence of (k, v)");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    /* pass 1: size + validation */
+    Py_ssize_t payload = 0;
+    const char *prev = NULL;
+    Py_ssize_t prev_len = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        char *k, *v;
+        Py_ssize_t klen, vlen;
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2 ||
+            PyBytes_AsStringAndSize(PyTuple_GET_ITEM(item, 0), &k, &klen) < 0 ||
+            PyBytes_AsStringAndSize(PyTuple_GET_ITEM(item, 1), &v, &vlen) < 0) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError, "expected (bytes, bytes)");
+            Py_DECREF(seq);
+            return NULL;
+        }
+        if (klen > 0xFFFF || vlen > 0xFFFFFFFFLL) {
+            PyErr_SetString(PyExc_ValueError, "redwood entry too large");
+            Py_DECREF(seq);
+            return NULL;
+        }
+        Py_ssize_t cap = prev_len < klen ? prev_len : klen;
+        if (cap > 0xFFFF)
+            cap = 0xFFFF;
+        Py_ssize_t shared = 0;
+        while (shared < cap && prev[shared] == k[shared])
+            shared++;
+        payload += 8 + (klen - shared) + vlen;
+        prev = k;
+        prev_len = klen;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 16 + payload);
+    if (!out) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    uint8_t *o = (uint8_t *)PyBytes_AS_STRING(out);
+    uint8_t *p = o + 16;
+    prev = NULL;
+    prev_len = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        char *k = PyBytes_AS_STRING(PyTuple_GET_ITEM(item, 0));
+        char *v = PyBytes_AS_STRING(PyTuple_GET_ITEM(item, 1));
+        Py_ssize_t klen = PyBytes_GET_SIZE(PyTuple_GET_ITEM(item, 0));
+        Py_ssize_t vlen = PyBytes_GET_SIZE(PyTuple_GET_ITEM(item, 1));
+        Py_ssize_t cap = prev_len < klen ? prev_len : klen;
+        if (cap > 0xFFFF)
+            cap = 0xFFFF;
+        Py_ssize_t shared = 0;
+        while (shared < cap && prev[shared] == k[shared])
+            shared++;
+        uint16_t sh16 = (uint16_t)shared, sl16 = (uint16_t)(klen - shared);
+        uint32_t vl32 = (uint32_t)vlen;
+        memcpy(p, &sh16, 2);
+        memcpy(p + 2, &sl16, 2);
+        memcpy(p + 4, &vl32, 4);
+        p += 8;
+        memcpy(p, k + shared, klen - shared);
+        p += klen - shared;
+        memcpy(p, v, vlen);
+        p += vlen;
+        prev = k;
+        prev_len = klen;
+    }
+    uint32_t magic = REDWOOD_BLOCK_MAGIC, n32 = (uint32_t)n,
+             pl32 = (uint32_t)payload;
+    uint32_t crc = crc32c_sw(0, o + 16, payload);
+    memcpy(o, &magic, 4);
+    memcpy(o + 4, &n32, 4);
+    memcpy(o + 8, &pl32, 4);
+    memcpy(o + 12, &crc, 4);
+    Py_DECREF(seq);
+    return out;
+}
+
+static PyObject *py_redwood_decode_block(PyObject *self, PyObject *arg) {
+    Py_buffer data;
+    if (PyObject_GetBuffer(arg, &data, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const uint8_t *b = (const uint8_t *)data.buf;
+    if (data.len < 16)
+        goto corrupt;
+    uint32_t magic, n, plen, crc;
+    memcpy(&magic, b, 4);
+    memcpy(&n, b + 4, 4);
+    memcpy(&plen, b + 8, 4);
+    memcpy(&crc, b + 12, 4);
+    if (magic != REDWOOD_BLOCK_MAGIC || (Py_ssize_t)plen != data.len - 16 ||
+        crc32c_sw(0, b + 16, plen) != crc)
+        goto corrupt;
+    {
+        PyObject *out = PyList_New(n);
+        if (!out) {
+            PyBuffer_Release(&data);
+            return NULL;
+        }
+        const uint8_t *p = b + 16, *end = b + 16 + plen;
+        PyObject *prev_key = NULL;
+        for (uint32_t i = 0; i < n; i++) {
+            if (end - p < 8)
+                goto corrupt_list;
+            uint16_t shared, slen;
+            uint32_t vlen;
+            memcpy(&shared, p, 2);
+            memcpy(&slen, p + 2, 2);
+            memcpy(&vlen, p + 4, 4);
+            p += 8;
+            if ((Py_ssize_t)(end - p) < (Py_ssize_t)slen + (Py_ssize_t)vlen ||
+                (prev_key == NULL && shared != 0) ||
+                (prev_key != NULL && shared > PyBytes_GET_SIZE(prev_key)))
+                goto corrupt_list;
+            PyObject *key = PyBytes_FromStringAndSize(NULL, shared + slen);
+            if (!key)
+                goto err_list;
+            if (shared)
+                memcpy(PyBytes_AS_STRING(key), PyBytes_AS_STRING(prev_key),
+                       shared);
+            memcpy(PyBytes_AS_STRING(key) + shared, p, slen);
+            p += slen;
+            PyObject *val = PyBytes_FromStringAndSize((const char *)p, vlen);
+            p += vlen;
+            PyObject *pair = val ? PyTuple_Pack(2, key, val) : NULL;
+            Py_XDECREF(val);
+            if (!pair) {
+                Py_DECREF(key);
+                goto err_list;
+            }
+            PyList_SET_ITEM(out, i, pair);
+            Py_XDECREF(prev_key);
+            prev_key = key; /* transfer our ref; pair holds its own */
+        }
+        Py_XDECREF(prev_key);
+        if (p != end)
+            goto corrupt_obj;
+        PyBuffer_Release(&data);
+        return out;
+    corrupt_list:
+        Py_XDECREF(prev_key);
+        Py_DECREF(out);
+        goto corrupt;
+    err_list:
+        Py_XDECREF(prev_key);
+        Py_DECREF(out);
+        PyBuffer_Release(&data);
+        return NULL;
+    corrupt_obj:
+        Py_DECREF(out);
+        goto corrupt;
+    }
+corrupt:
+    PyBuffer_Release(&data);
+    PyErr_SetString(PyExc_ValueError, "corrupt redwood block");
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 /* Bulk key encoding                                                   */
 /* ------------------------------------------------------------------ */
 
@@ -2103,6 +2288,12 @@ static PyTypeObject VStoreType = {
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
+    {"redwood_encode_block", py_redwood_encode_block, METH_O,
+     "redwood_encode_block([(key, value), ...]) -> bytes (sorted keys, "
+     "prefix-compressed; bit-identical to storage/redwood.py "
+     "py_encode_block)"},
+    {"redwood_decode_block", py_redwood_decode_block, METH_O,
+     "redwood_decode_block(bytes) -> [(key, value), ...]"},
     {"encode_conflict_ranges", py_encode_conflict_ranges, METH_VARARGS,
      "encode_conflict_ranges(txns, skip_or_None, rb, re, wb, we, rtxn, "
      "wtxn, key_bytes) -> (n_reads, n_writes)"},

@@ -150,6 +150,14 @@ class _LocalFile:
         with open(self.path, "rb") as f:
             return f.read()
 
+    def read_range(self, offset: int, length: int) -> bytes:
+        """Positioned read (pread) — the redwood engine's block fetch path;
+        SimFile deliberately lacks this so sim runs keep whole-image reads
+        and the engine caches the image instead."""
+        import os
+        self._f.flush()
+        return os.pread(self._f.fileno(), length, offset)
+
     def truncate(self):
         self._f.truncate(0)
         self._f.seek(0)

@@ -90,16 +90,48 @@ class StorageServer:
         self._revoked: list[tuple[bytes, bytes | None, int]] = []
         # engine selection (openKVStore dispatch IKeyValueStore.h:66,
         # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
-        # WAL (kill-injected durability faults); "ssd" = host B-tree over
-        # platform SQLite on a REAL file (survives sim reboots; torn-write
-        # injection does not apply to it)
-        from foundationdb_tpu.storage.kvstore import open_kv_store
+        # WAL (kill-injected durability faults); "redwood" = log-structured
+        # WAL + memtable + compacted runs over the SAME file surface (torn
+        # tails apply to its WAL and run files too); "ssd" = host B-tree
+        # over platform SQLite on a REAL file (survives sim reboots;
+        # torn-write injection does not apply to it)
+        from foundationdb_tpu.storage.kvstore import (
+            open_kv_store, validate_storage_engine)
         self.engine = engine or KNOBS.STORAGE_ENGINE
+        validate_storage_engine(self.engine)
         if self.engine == "memory":
             self.store = open_kv_store(
                 "memory",
                 file0=process.net.open_file(process, f"storage-{tag}.0"),
                 file1=process.net.open_file(process, f"storage-{tag}.1"))
+        elif self.engine == "redwood":
+            # run files live beside the WAL under the "storage-{tag}."
+            # prefix, so worker reboot detection (any file named storage-*)
+            # re-attaches this role like the memory engine's WAL; over the
+            # real transport the same names land in the process data dir
+            prefix = f"storage-{tag}."
+
+            def _rw_open(name: str, _p=prefix, _proc=process):
+                return _proc.net.open_file(_proc, _p + name)
+
+            def _rw_existing(_p=prefix, _proc=process):
+                names = {n for n in _proc.files
+                         if n.startswith(_p + "rw.")}
+                data_dir = getattr(_proc.net, "data_dir", None)
+                if data_dir:  # real transport: files survive the process
+                    import os
+                    d = os.path.join(data_dir,
+                                     _proc.address.replace(":", "_"))
+                    if os.path.isdir(d):
+                        names.update(n for n in os.listdir(d)
+                                     if n.startswith(_p + "rw."))
+                return sorted(n[len(_p):] for n in names)
+
+            self.store = open_kv_store(
+                "redwood",
+                file0=process.net.open_file(process, f"storage-{tag}.0"),
+                file1=process.net.open_file(process, f"storage-{tag}.1"),
+                open_file=_rw_open, existing_files=_rw_existing)
         else:
             import os
             base = KNOBS.SSD_DATA_DIR or _default_ssd_dir()
@@ -168,11 +200,27 @@ class StorageServer:
             # a fetchKeys splice needs the loop parked; bail out of retries
             interrupted=lambda: self._ingest_gate is not None)
         self._pull_task = process.spawn(self._update_loop(), "ssUpdate")
+        # true while an engine commit is running off-loop (real event loop
+        # only — under sim run_blocking is inline, so no other actor can
+        # ever observe it set). The redwood maintenance actor must not
+        # mutate the shared WAL queue (apply_maintenance pops/truncates it)
+        # while a commit thread is pushing to it.
+        self._commit_inflight = False
+        self._maint_task = None
+        if self.engine == "redwood":
+            # flush/compaction actor (the reference's Redwood drives these
+            # from the storage server's actor model too). Decisions are a
+            # pure function of applied byte counts and the poll tick, so the
+            # same seed produces the same flush/compaction sequence.
+            self._maint_task = process.spawn(
+                self._redwood_maintenance_loop(), "ssCompaction")
 
     def shutdown(self):
         """Displaced by a re-created storage role on the same worker."""
         self._pull_task.cancel()
         self._counters_task.cancel()
+        if self._maint_task is not None:
+            self._maint_task.cancel()
 
     def _on_metrics(self, req, reply):
         snap = self.counters.as_dict()
@@ -465,9 +513,31 @@ class StorageServer:
                     self.version.set(end_v)
                     self.data.latest_version = max(self.data.latest_version, end_v)
                     self._trigger_watches(end_v)
-            self._advance_durability()
+            await self._advance_durability()
 
-    def _advance_durability(self):
+    async def _redwood_maintenance_loop(self):
+        """Background flush/compaction driver for the redwood engine: plan
+        on-loop, build off-loop (run_blocking — pure CPU + reads of
+        immutable files, the resolver's drain-off-the-loop idiom), install
+        on-loop. Under sim run_blocking executes inline, so the sequence is
+        deterministic; under the real loop only the cheap install blocks."""
+        loop = self.process.net.loop
+        while True:
+            # plan/apply mutate engine structures shared with commit's WAL
+            # push — hold off while a commit thread is in flight (real loop
+            # only; the build overlap below is fine, it's pure)
+            while self._commit_inflight:
+                await loop.delay(0.01)
+            plan = self.store.plan_maintenance()
+            if plan is None:
+                await loop.delay(KNOBS.REDWOOD_MAINT_INTERVAL)
+                continue
+            image = await loop.run_blocking(plan.build)
+            while self._commit_inflight:
+                await loop.delay(0.01)
+            self.store.apply_maintenance(plan, image)
+
+    async def _advance_durability(self):
         """updateStorage (:2633): write mutations leaving the MVCC window to
         the durable engine, commit, then forget them from memory and pop the
         TLog — pop strictly after the engine commit, so a crash between the
@@ -489,10 +559,19 @@ class StorageServer:
                 self._apply_durable(m)
         self.durable_version = target
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
-        # the engine commit stays ON the loop: the sqlite connection is
-        # loop-thread-bound, and an await window here would let reads and
-        # shard changes interleave with a half-committed durability advance
-        self.store.commit()
+        # the engine commit runs OFF the loop (run_blocking; inline under
+        # sim, a worker thread under the real loop) so an fsync or sqlite
+        # COMMIT can't stall every read on this process. The await window is
+        # safe: target <= _known_committed <= any recovery version, so
+        # nothing at or below `target` can be rolled back mid-commit, reads
+        # go through the MVCC map (not the engine), and only this actor
+        # mutates the engine. forget/pop stay AFTER the awaited commit —
+        # the crash-ordering argument above needs the commit durable first.
+        self._commit_inflight = True
+        try:
+            await self.process.net.loop.run_blocking(self.store.commit)
+        finally:
+            self._commit_inflight = False
         self.data.forget_before(target)
         popped: set[tuple[str, str]] = set()
         for epoch in self.log_epochs:

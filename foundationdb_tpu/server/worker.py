@@ -13,12 +13,18 @@ from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.coordination import CoordToken, get_leader
 from foundationdb_tpu.server.interfaces import (
     InitRoleReply, InitRoleRequest, RegisterWorkerRequest, Token)
+from foundationdb_tpu.storage.kvstore import validate_storage_engine
 from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
 
 
 class Worker:
     def __init__(self, process: SimProcess, coordinators: list[str],
                  capabilities: list[str], process_class: str = "unset"):
+        # fail at boot on a misconfigured engine, not on the first storage
+        # recruitment minutes later (openKVStore would raise eventually, but
+        # only on whichever worker happens to get a storage role)
+        validate_storage_engine(KNOBS.STORAGE_ENGINE)
         self.process = process
         self.coordinators = coordinators
         self.capabilities = capabilities

@@ -11,6 +11,12 @@ on KeyValueStoreType, fdbclient/FDBTypes.h:472). Engines here:
   (KeyValueStoreSQLite.actor.cpp, a vendored SQLite B-tree). Here: the
   platform SQLite via the stdlib binding over a real file — a host B-tree for
   real deployments; not used inside the deterministic simulator.
+- RedwoodKeyValueStore (storage/redwood.py) — the reference's
+  `ssd-redwood-v1` direction (VersionedBTree.actor.cpp): WAL + memtable +
+  immutable prefix-compressed sorted runs with leveled background
+  compaction, for datasets the memory engine can't hold resident. Runs on
+  SimFiles under the simulator (kill-injected durability faults apply) and
+  on real files over the net transport.
 
 Engines are synchronous at this layer; roles call commit() at their own
 group-commit points (the event loop is cooperative, so a sync commit is a
@@ -184,7 +190,11 @@ class SSDKeyValueStore:
     def __init__(self, path: str):
         import sqlite3
 
-        self.db = sqlite3.connect(path, isolation_level=None)
+        # check_same_thread=False: the storage server commits off the actor
+        # loop through run_blocking, which under the real event loop runs in
+        # a worker thread; SQLite itself is serialized-mode thread-safe
+        self.db = sqlite3.connect(path, isolation_level=None,
+                                  check_same_thread=False)
         self.db.execute("PRAGMA journal_mode=WAL")
         self.db.execute("PRAGMA synchronous=FULL")
         self.db.execute(
@@ -227,10 +237,33 @@ class SSDKeyValueStore:
         pass  # SQLite recovers via its own WAL on connect
 
 
+# the KeyValueStoreType universe (FDBTypes.h:472) — "ssd-2" is an alias the
+# reference keeps for its second sqlite format; redwood is the log-structured
+# engine in storage/redwood.py
+VALID_STORAGE_ENGINES = ("memory", "ssd", "ssd-2", "redwood")
+
+
+def validate_storage_engine(name: str) -> None:
+    """Fail FAST on a bad STORAGE_ENGINE — at worker boot, not on the first
+    storage recruitment minutes later (and never by silently falling back
+    to some other engine)."""
+    if name not in VALID_STORAGE_ENGINES:
+        raise FDBError(
+            "invalid_option",
+            f"unknown STORAGE_ENGINE {name!r}: valid engines are "
+            + ", ".join(VALID_STORAGE_ENGINES))
+
+
 def open_kv_store(store_type: str, **kwargs) -> IKeyValueStore:
     """openKVStore dispatch (IKeyValueStore.h:66, KeyValueStoreType)."""
     if store_type == "memory":
         return MemoryKeyValueStore(kwargs["file0"], kwargs["file1"])
     if store_type in ("ssd", "ssd-2"):
         return SSDKeyValueStore(kwargs["path"])
+    if store_type == "redwood":
+        from foundationdb_tpu.storage.redwood import RedwoodKeyValueStore
+        return RedwoodKeyValueStore(kwargs["file0"], kwargs["file1"],
+                                    kwargs["open_file"],
+                                    kwargs.get("existing_files"))
+    validate_storage_engine(store_type)  # raises with the valid list
     raise FDBError("invalid_option", f"unknown storage engine {store_type}")

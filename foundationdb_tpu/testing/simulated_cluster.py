@@ -42,7 +42,16 @@ _ACCEL_FAST_SHAPE = {
 # so environments with a broken accelerator stack still sweep — pass
 # allow_backends=("oracle", "device", "sharded") to include it
 DEFAULT_BACKENDS = ("oracle", "device")
-DEFAULT_ENGINES = ("memory", "ssd")
+DEFAULT_ENGINES = ("memory", "ssd", "redwood")
+
+# redwood draws shrink the engine's budgets so test-scale datasets actually
+# flush and compact (at the production defaults a 25s spec never fills the
+# 4MB memtable and the LSM path would go unexercised)
+_REDWOOD_SIM_SHAPE = {
+    "REDWOOD_MEMTABLE_BYTES": 2_048,
+    "REDWOOD_BLOCK_BYTES": 512,
+    "REDWOOD_COMPACTION_FAN_IN": 2,
+}
 
 
 @dataclass(frozen=True)
@@ -53,7 +62,7 @@ class ClusterDraw:
 
     seed: int
     replication: str       # "single" | "double" | "two_region"
-    storage_engine: str    # "memory" | "ssd"
+    storage_engine: str    # "memory" | "ssd" | "redwood"
     conflict_backend: str  # "oracle" | "device" | "sharded"
     n_workers: int
     n_proxies: int
@@ -148,6 +157,9 @@ class ClusterDraw:
         KNOBS.set("CONFLICT_BACKEND", self.conflict_backend)
         if self.conflict_backend in ("device", "sharded"):
             for k, v in _ACCEL_FAST_SHAPE.items():
+                KNOBS.set(k, v)
+        if self.storage_engine == "redwood":
+            for k, v in _REDWOOD_SIM_SHAPE.items():
                 KNOBS.set(k, v)
 
     def factory(self) -> Callable:

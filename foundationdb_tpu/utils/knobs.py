@@ -211,8 +211,16 @@ KNOBS.init("DD_HOT_SHARD_ROUNDS", 2)  # consecutive hot DD rounds before split
 
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
 KNOBS.init("CC_PREEMPT_INTERVAL_SECONDS", 5.0)  # betterMasterExists poll
-KNOBS.init("STORAGE_ENGINE", "memory")  # "memory" | "ssd" (KeyValueStoreType)
+KNOBS.init("STORAGE_ENGINE", "memory")  # "memory" | "ssd" | "redwood" (KeyValueStoreType)
 KNOBS.init("SSD_DATA_DIR", "")  # "" -> the platform temp dir
+
+# --- Redwood storage engine (storage/redwood.py; the reference's
+# ssd-redwood-v1, VersionedBTree.actor.cpp knob family) ---
+KNOBS.init("REDWOOD_MEMTABLE_BYTES", 4_000_000, (8_192,))  # flush trigger
+KNOBS.init("REDWOOD_BLOCK_BYTES", 16_384, (512,))  # sorted-block target size
+KNOBS.init("REDWOOD_COMPACTION_FAN_IN", 4, (2,))  # runs per level -> merge
+KNOBS.init("REDWOOD_BLOCK_CACHE_BLOCKS", 1_024, (2,))  # decoded-block cache
+KNOBS.init("REDWOOD_MAINT_INTERVAL", 0.25)  # storage-server poll period
 KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
 # a storage worker silent for this long is treated as permanently failed and
 # its shards are re-replicated onto a replacement (storageServerFailureTracker

@@ -1,0 +1,45 @@
+#!/bin/sh
+# Compile-smoke for the native extension (foundationdb_tpu/native/fdb_native.c).
+#
+# Builds the extension from scratch into a throwaway directory (never the
+# package dir — CI must not clobber the lazily-built fdb_native.so other
+# tests may be using) and import-checks the symbols the Python side
+# dispatches on. Exit codes:
+#   0  — built and imported cleanly
+#   75 — no C compiler on PATH (EX_TEMPFAIL: callers skip, not fail)
+#   1  — compile or import failed (a real regression)
+set -eu
+
+REPO_DIR=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
+SRC="$REPO_DIR/foundationdb_tpu/native/fdb_native.c"
+CC=${CC:-cc}
+
+if ! command -v "$CC" >/dev/null 2>&1; then
+    echo "build_native: no C compiler ('$CC') on PATH — skipping" >&2
+    exit 75
+fi
+
+TMPDIR_BUILD=$(mktemp -d)
+trap 'rm -rf "$TMPDIR_BUILD"' EXIT
+
+INCLUDE=$(python3 -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+SO="$TMPDIR_BUILD/fdb_native.so"
+
+"$CC" -O2 -shared -fPIC -Wall -I"$INCLUDE" "$SRC" -o "$SO"
+
+# import the fresh build and probe the dispatch surface (crc32c is the
+# oldest symbol, redwood_* the newest — both must be present)
+python3 - "$SO" <<'EOF'
+import importlib.util, sys
+# the name must match the C module's PyInit_fdb_native export
+spec = importlib.util.spec_from_file_location("fdb_native", sys.argv[1])
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+for sym in ("crc32c", "encode_keys_into", "redwood_encode_block",
+            "redwood_decode_block"):
+    assert hasattr(m, sym), f"missing symbol {sym}"
+img = m.redwood_encode_block([(b"a", b"1"), (b"ab", b"2")])
+assert m.redwood_decode_block(img) == [(b"a", b"1"), (b"ab", b"2")]
+assert m.crc32c(b"123456789") == 0xE3069283  # CRC-32C check value
+print("build_native: OK")
+EOF

@@ -1,0 +1,22 @@
+"""Tier-1 compile-smoke for the native extension: scripts/build_native.sh
+builds fdb_native.c from scratch in a temp dir and import-checks the
+dispatch surface (crc32c, bulk key encoding, the redwood block codec).
+Skips cleanly (exit 75, EX_TEMPFAIL) on hosts without a C compiler — the
+pure-Python fallbacks are the supported path there."""
+
+import os
+import subprocess
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                       "build_native.sh")
+
+
+def test_native_extension_compiles_and_imports():
+    proc = subprocess.run(["sh", _SCRIPT], capture_output=True, text=True,
+                          timeout=300)
+    if proc.returncode == 75:
+        pytest.skip("no C compiler on PATH")
+    assert proc.returncode == 0, proc.stderr
+    assert "build_native: OK" in proc.stdout

@@ -149,7 +149,7 @@ def _oracle_backend():
     yield
 
 
-@pytest.mark.parametrize("engine", ["memory", "ssd"])
+@pytest.mark.parametrize("engine", ["memory", "ssd", "redwood"])
 def test_storage_server_reboot_preserves_durable_data(engine, tmp_path):
     # small MVCC window so durability advances quickly; the storage role
     # opens the configured engine via open_kv_store (IKeyValueStore.h:66)
@@ -157,6 +157,12 @@ def test_storage_server_reboot_preserves_durable_data(engine, tmp_path):
     KNOBS.set("MAX_VERSIONS_IN_FLIGHT", 1_000_000_000)
     KNOBS.set("STORAGE_ENGINE", engine)
     KNOBS.set("SSD_DATA_DIR", str(tmp_path))
+    if engine == "redwood":
+        # tiny budgets: the 30-key write set must cross a flush so the
+        # reboot recovers run files + WAL, not just the WAL
+        KNOBS.set("REDWOOD_MEMTABLE_BYTES", 256)
+        KNOBS.set("REDWOOD_BLOCK_BYTES", 512)
+        KNOBS.set("REDWOOD_COMPACTION_FAN_IN", 2)
     c = SimCluster(seed=5)
     db = c.database()
     ss_addr = c.storage_procs[0].address

@@ -1,0 +1,319 @@
+"""Redwood engine unit tests: block codec parity (C vs Python), flush /
+compaction life-cycle, crash recovery (torn tails, half-finished
+compactions), and the PROTO005-style C-schema pin for the on-disk structs.
+
+The model-check idiom follows tests/test_vstore_parity.py: drive the engine
+and a plain dict model with one mutation stream and demand identical reads.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.sim import SimFile
+from foundationdb_tpu.storage import redwood as R
+from foundationdb_tpu.storage.redwood import RedwoodKeyValueStore
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+
+
+@pytest.fixture(autouse=True)
+def _tiny_budgets():
+    # small enough that a few hundred mutations exercise flush AND multiple
+    # compaction levels
+    KNOBS.set("REDWOOD_MEMTABLE_BYTES", 512)
+    KNOBS.set("REDWOOD_BLOCK_BYTES", 128)
+    KNOBS.set("REDWOOD_COMPACTION_FAN_IN", 2)
+    yield
+
+
+class _Files:
+    """SimFile surface for the engine: WAL pair + named run files."""
+
+    def __init__(self, seed=0):
+        self.rng = DeterministicRandom(seed)
+        self.files: dict[str, SimFile] = {}
+
+    def open(self, name):
+        if name not in self.files:
+            self.files[name] = SimFile(name, self.rng.fork())
+        return self.files[name]
+
+    def existing(self):
+        return [n for n in self.files if n.startswith("rw.")]
+
+    def store(self) -> RedwoodKeyValueStore:
+        return RedwoodKeyValueStore(self.open("wal.0"), self.open("wal.1"),
+                                    self.open, self.existing)
+
+    def kill_all(self):
+        for f in self.files.values():
+            f.on_kill()
+
+
+# ---------------------------------------------------------------------------
+# block codec
+# ---------------------------------------------------------------------------
+
+def _random_items(rng, n):
+    keys = sorted({bytes(rng.randint(97, 103) for _ in range(
+        rng.randint(1, 12))) for _ in range(n)})
+    return [(k, bytes(rng.randint(0, 255) for _ in range(rng.randint(0, 20))))
+            for k in keys]
+
+
+def test_block_codec_roundtrip_python():
+    rng = DeterministicRandom(1)
+    for _ in range(50):
+        items = _random_items(rng, rng.randint(0, 30))
+        assert R.py_decode_block(R.py_encode_block(items)) == items
+
+
+def test_block_codec_c_python_parity():
+    from foundationdb_tpu import native
+    if not (native.available() and hasattr(native.mod,
+                                           "redwood_encode_block")):
+        pytest.skip("native module without redwood codec")
+    rng = DeterministicRandom(2)
+    for _ in range(100):
+        items = _random_items(rng, rng.randint(0, 30))
+        c_img = native.mod.redwood_encode_block(items)
+        py_img = R.py_encode_block(items)
+        assert c_img == py_img  # byte-identical, not just equivalent
+        assert native.mod.redwood_decode_block(py_img) == items
+        assert R.py_decode_block(c_img) == items
+
+
+def test_block_codec_rejects_corruption():
+    img = bytearray(R.py_encode_block([(b"a", b"1"), (b"ab", b"2")]))
+    img[-1] ^= 0xFF
+    with pytest.raises(Exception, match="checksum|corrupt"):
+        R.py_decode_block(bytes(img))
+
+
+# ---------------------------------------------------------------------------
+# life-cycle: flush, compaction, model equality
+# ---------------------------------------------------------------------------
+
+def _mutate(rng, store, model, n_ops):
+    for _ in range(n_ops):
+        r = rng.random()
+        if r < 0.70:
+            k = f"k{rng.randint(0, 200):04d}".encode()
+            v = bytes(rng.randint(0, 255) for _ in range(rng.randint(1, 15)))
+            store.set(k, v)
+            model[k] = v
+        elif r < 0.85:
+            a, b = sorted((rng.randint(0, 200), rng.randint(0, 200)))
+            begin, end = f"k{a:04d}".encode(), f"k{b:04d}".encode()
+            store.clear_range(begin, end)
+            for k in [k for k in model if begin <= k < end]:
+                del model[k]
+        else:
+            store.commit()
+            store.maintain()
+
+
+def _assert_equal(store, model):
+    items = sorted(model.items())
+    assert store.get_range(b"", b"\xff" * 8) == items
+    assert store.get_range(b"", b"\xff" * 8, reverse=True) == \
+        items[::-1]
+    assert store.get_range(b"", b"\xff" * 8, limit=5) == items[:5]
+    assert store.get_range(b"", b"\xff" * 8, limit=0) == []
+    for k in list(model)[:50]:
+        assert store.get(k) == model[k]
+    assert store.get(b"nonexistent-key") is None
+
+
+def test_flush_compaction_and_reads_match_model():
+    files = _Files(seed=7)
+    store = files.store()
+    model: dict[bytes, bytes] = {}
+    rng = DeterministicRandom(7)
+    _mutate(rng, store, model, 600)
+    store.commit()
+    store.maintain()
+    # the tiny budgets must have pushed runs past level 0
+    assert any(lv >= 1 for lv in store.level_shape()), store.level_shape()
+    _assert_equal(store, model)
+
+
+def test_recover_after_clean_shutdown():
+    files = _Files(seed=8)
+    store = files.store()
+    model: dict[bytes, bytes] = {}
+    rng = DeterministicRandom(8)
+    _mutate(rng, store, model, 400)
+    store.set_metadata("durableVersion", b"123")
+    store.commit()
+    store2 = files.store()
+    store2.recover()
+    _assert_equal(store2, model)
+    assert store2.get_metadata("durableVersion") == b"123"
+
+
+def test_recover_after_kill_preserves_committed_state():
+    files = _Files(seed=9)
+    store = files.store()
+    model: dict[bytes, bytes] = {}
+    rng = DeterministicRandom(9)
+    _mutate(rng, store, model, 400)
+    store.commit()  # everything in `model` is now durable
+    # uncommitted suffix: may survive partially (torn tail) — must not
+    # corrupt anything, and committed state must be complete
+    store.set(b"uncommitted", b"x")
+    files.kill_all()
+    store2 = files.store()
+    store2.recover()
+    for k, v in model.items():
+        assert store2.get(k) == v, k
+    got = dict(store2.get_range(b"", b"\xff" * 8))
+    for k in got:
+        assert k in model or k == b"uncommitted"
+
+
+def test_recovery_heals_half_finished_compaction():
+    """Crash between the merged run's sync and the source truncation: both
+    survive on disk; recovery must keep the merged run, drop + truncate the
+    sources, and serve identical data."""
+    files = _Files(seed=10)
+    store = files.store()
+    model: dict[bytes, bytes] = {}
+    rng = DeterministicRandom(10)
+    # two flushes -> two runs at level 0 (fan-in 2 makes compaction due)
+    for round_ in range(2):
+        for i in range(40):
+            k = f"h{round_}{i:03d}".encode()
+            store.set(k, b"v" * 8)
+            model[k] = b"v" * 8
+        store.commit()
+        plan = store.plan_maintenance()
+        assert plan is not None and plan.kind == "flush"
+        store.apply_maintenance(plan, plan.build())
+    assert store.level_shape() == {0: 2}
+    plan = store.plan_maintenance()
+    assert plan is not None and plan.kind == "compact"
+    image = plan.build()
+    # simulate the crash: merged run durable, sources NOT truncated
+    f = files.open(f"rw.{plan.run_id}")
+    f.append(image)
+    f.sync()
+    store2 = files.store()
+    store2.recover()
+    assert store2.level_shape() == {1: 1}
+    for src in plan.source_ids:
+        assert files.files[f"rw.{src}"].read_all() == b""  # healed
+    for k, v in model.items():
+        assert store2.get(k) == v
+
+
+def test_torn_run_file_is_ignored_and_truncated():
+    """A run that fails its body CRC is dropped and reclaimed at recovery.
+    The data still reads back here because the DiskQueue pop is lazy (space
+    is reclaimed at file swap, not at pop), so the flushed ops survive in
+    the WAL and replay idempotently over the dropped run."""
+    files = _Files(seed=11)
+    store = files.store()
+    for i in range(60):
+        store.set(f"t{i:03d}".encode(), b"v" * 8)
+    store.commit()
+    store.maintain()
+    names = store.run_names()
+    assert names
+    # tear the newest run: recovery must drop it and fall back to the WAL
+    torn = files.files[names[0]]
+    torn.durable = torn.durable[: len(torn.durable) // 2]
+    store2 = files.store()
+    store2.recover()
+    assert torn.read_all() == b""  # reclaimed
+    for i in range(60):
+        assert store2.get(f"t{i:03d}".encode()) == b"v" * 8
+
+
+def test_metadata_only_churn_flushes_and_reclaims_wal():
+    """Durable-version bumps with no data writes must not grow the WAL
+    forever: the _wal_bytes trigger flushes (possibly an entries-empty run)
+    and pops the WAL."""
+    files = _Files(seed=12)
+    store = files.store()
+    store.set(b"seed", b"1")
+    store.commit()
+    for v in range(400):
+        store.set_metadata("durableVersion", str(v).encode())
+        store.commit()
+        store.maintain()
+    assert len(store.queue.live_entries) < 400
+    store2 = files.store()
+    store2.recover()
+    assert store2.get_metadata("durableVersion") == b"399"
+    assert store2.get(b"seed") == b"1"
+
+
+def test_clear_range_shadows_older_runs():
+    files = _Files(seed=13)
+    store = files.store()
+    for i in range(40):
+        store.set(f"s{i:03d}".encode(), b"old")
+    store.commit()
+    store.maintain()  # data now lives in a run
+    store.clear_range(b"s010", b"s020")
+    store.set(b"s012", b"new")
+    store.commit()
+    assert store.get(b"s011") is None
+    assert store.get(b"s012") == b"new"
+    assert store.get(b"s009") == b"old"
+    got = store.get_range(b"s005", b"s025")
+    keys = [k for k, _ in got]
+    assert b"s011" not in keys and b"s012" in keys
+    # and the same through a flush of the tombstone + recovery
+    store.maintain()
+    store2 = files.store()
+    store2.recover()
+    assert store2.get(b"s011") is None
+    assert store2.get(b"s012") == b"new"
+
+
+# ---------------------------------------------------------------------------
+# C-schema pin (PROTO005 discipline for the on-disk structs)
+# ---------------------------------------------------------------------------
+
+_EXPECTED_SCHEMAS = {
+    "RedwoodBlockHeader": R.BLOCK_HEADER_FIELDS,
+    "RedwoodBlockEntry": R.BLOCK_ENTRY_FIELDS,
+    "RedwoodRunHeader": R.RUN_HEADER_FIELDS,
+    "RedwoodRunIndexEntry": R.RUN_INDEX_FIELDS,
+}
+
+
+def _c_source():
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "foundationdb_tpu", "native", "fdb_native.c")
+    with open(path) as f:
+        return f.read()
+
+
+def test_c_schema_comments_match_python_structs():
+    from foundationdb_tpu.analysis.protolint import parse_c_schemas
+    schemas = {s.name: s.fields for s in parse_c_schemas(_c_source())
+               if s.name in _EXPECTED_SCHEMAS}
+    assert schemas == _EXPECTED_SCHEMAS
+
+
+def test_c_schema_check_detects_drift():
+    """Mutation-proving negative case: a renamed field in the C comment must
+    make the comparison fail (i.e. the gate above has teeth)."""
+    from foundationdb_tpu.analysis.protolint import parse_c_schemas
+    mutated = _c_source().replace("payload_bytes: u32", "payload_len: u32")
+    assert mutated != _c_source()
+    schemas = {s.name: s.fields for s in parse_c_schemas(mutated)
+               if s.name in _EXPECTED_SCHEMAS}
+    assert schemas != _EXPECTED_SCHEMAS
+
+
+def test_struct_sizes_are_pinned():
+    """Byte sizes are wire format: changing one silently breaks every
+    existing store. Pin them."""
+    assert R._BLOCK_HEADER.size == 16
+    assert R._BLOCK_ENTRY.size == 8
+    assert R._RUN_HEADER.size == 48
+    assert R._RUN_INDEX.size == 10

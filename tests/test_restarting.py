@@ -102,6 +102,17 @@ def test_restart_from_disk_ssd_engine(tmp_path):
     assert cyc.rotations > 0
 
 
+def test_restart_from_disk_redwood_engine(tmp_path):
+    # shrink the engine budgets so the first half actually flushes runs and
+    # compacts them BEFORE the plug is pulled — the restart then exercises
+    # run-file recovery + WAL replay, not just an empty-levels WAL replay
+    KNOBS.set("REDWOOD_MEMTABLE_BYTES", 4_096)
+    KNOBS.set("REDWOOD_BLOCK_BYTES", 512)
+    KNOBS.set("REDWOOD_COMPACTION_FAN_IN", 2)
+    cyc = _restart_spec(704, "redwood", tmp_path)
+    assert cyc.rotations > 0
+
+
 @pytest.mark.slow
 def test_restart_from_disk_double_replication(tmp_path):
     """Restart with replicated teams: both replicas of every shard recover
