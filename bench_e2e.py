@@ -103,11 +103,15 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
         # (<= 10 ranges/txn), and the state capacity is sized to the
         # keyspace's segment count rather than the default 64k.
         # 10 ranges/txn so a full commit batch is ONE device step (dispatch
-        # and step cost are per-step, not per-txn)
-        txn_knobs.update({"CONFLICT_BATCH_TXNS": 256,
-                          "CONFLICT_BATCH_READS_PER_TXN": 10,
-                          "CONFLICT_BATCH_WRITES_PER_TXN": 10,
-                          "CONFLICT_STATE_CAPACITY": 8192})
+        # and step cost are per-step, not per-txn). setdefault: an explicit
+        # shape in extra_knobs wins (the sharded CPU smoke shrinks them —
+        # the SPMD step's full sandwich rounds make the 256-txn program a
+        # multi-minute XLA compile on the host backend).
+        for k, v in (("CONFLICT_BATCH_TXNS", 256),
+                     ("CONFLICT_BATCH_READS_PER_TXN", 10),
+                     ("CONFLICT_BATCH_WRITES_PER_TXN", 10),
+                     ("CONFLICT_STATE_CAPACITY", 8192)):
+            txn_knobs.setdefault(k, v)
     batch_knobs = {}
     if jax_kernel:
         # The step's CPU/device cost is nearly flat in txns carried (sort is
@@ -224,6 +228,15 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
                             "/tmp/fdb_tpu_jax_cache")
         core_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                             "1.0")
+    # FDBTPU_E2E_HOST_DEVICES=N: pin the core process's XLA host platform to
+    # N virtual devices — how the sharded backend gets a multi-device mesh
+    # on a CPU-only host (tier-1 smoke runs it at N=2)
+    host_devices = os.environ.get("FDBTPU_E2E_HOST_DEVICES")
+    if host_devices:
+        flags = [f for f in core_env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={host_devices}")
+        core_env["XLA_FLAGS"] = " ".join(flags)
     procs = [_spawn_server(core_spec, core_env)]
     for spec in proxy_specs + storage_specs:
         procs.append(_spawn_server(spec, env))
@@ -452,6 +465,7 @@ def _stage_breakdown(trace_dir: str) -> dict | None:
             "spans": rep["spans"], "unmatched": rep["unmatched"],
             "stages": rep["stages"],
             "queueing_ratio": rep["queueing_ratio"],
+            "readback_overlap_ratio": rep["readback_overlap_ratio"],
             "contention": rep["contention"]}
 
 

@@ -46,6 +46,12 @@ def build_role(process, role: str, args: dict):
         return Proxy(process, grv_only=True, **args)
     if role == "resolver":
         from foundationdb_tpu.server.resolver import Resolver
+        # key range rides the JSON spec hex-encoded (bytes aren't JSON);
+        # absent/None end = "to the end of keyspace"
+        if "key_range_begin" in args:
+            args["key_range_begin"] = bytes.fromhex(args["key_range_begin"])
+        if args.get("key_range_end") is not None:
+            args["key_range_end"] = bytes.fromhex(args["key_range_end"])
         return Resolver(process, **args)
     if role == "tlog":
         from foundationdb_tpu.server.tlog import TLog

@@ -1091,8 +1091,11 @@ def detect_async_impl(engine, txns: list[TxnConflictInfo],
         # double-buffering: the D2H copy starts NOW, overlapped with the
         # NEXT chunk's/batch's encode + dispatch, so a later drain (or
         # result()) finds the bytes already on the host instead of starting
-        # the transfer under a sync
-        if hasattr(combined, "copy_to_host_async"):
+        # the transfer under a sync. CONFLICT_READBACK_OVERLAP=False keeps
+        # the fully synchronous pre-overlap shape as a measurable ablation
+        # (decisions are identical either way — only timing shifts).
+        if (KNOBS.CONFLICT_READBACK_OVERLAP
+                and hasattr(combined, "copy_to_host_async")):
             combined.copy_to_host_async()
         chunks.append((sub, host_too_old, combined))
     # the kernel's floor advance is replicated host-side exactly
@@ -1213,16 +1216,17 @@ def drain_handles(handles: list["DetectHandle"]) -> None:
     """
     pend = [h for h in handles if h._result is None and h._chunks]
     arrs = [c[2] for h in pend for c in h._chunks]
-    for a in arrs:
-        if hasattr(a, "copy_to_host_async"):
-            a.copy_to_host_async()
+    if KNOBS.CONFLICT_READBACK_OVERLAP:
+        for a in arrs:
+            if hasattr(a, "copy_to_host_async"):
+                a.copy_to_host_async()
     for h in pend:
         h._chunks = [(sub, too_old, np.asarray(a))
                      for sub, too_old, a in h._chunks]
 
 
 def drain_and_collect(
-        handles: list["DetectHandle"],
+        handles: list["DetectHandle"], timing: dict | None = None,
 ) -> list[tuple[list[int] | None, "FDBError | None"]]:
     """drain_handles + result() for every handle, entirely off-loop.
 
@@ -1232,18 +1236,28 @@ def drain_and_collect(
     intra-batch pass (_exact_intra_host) on an unconverged chunk, which is
     milliseconds of host compute the event-loop thread should never eat.
     Errors are returned, not raised — a capacity overflow on one handle
-    must not strand the remaining handles' results."""
+    must not strand the remaining handles' results.
+
+    When `timing` is given, the device-sync ("drain_seconds") and host-
+    materialization ("collect_seconds") halves are recorded separately so
+    the caller can attribute them to distinct spans (the sharded path bills
+    the verdict unpack as Resolver.ShardCombine)."""
     import time
     t0 = time.perf_counter()
     drain_handles(handles)
+    t1 = time.perf_counter()
     out: list[tuple[list[int] | None, FDBError | None]] = []
     for h in handles:
         try:
             out.append((h.result(), None))
         except FDBError as e:
             out.append((None, e))
+    t2 = time.perf_counter()
+    if timing is not None:
+        timing["drain_seconds"] = t1 - t0
+        timing["collect_seconds"] = t2 - t1
     _readback_waits.increment()
-    _readback_wait_seconds.increment(time.perf_counter() - t0)
+    _readback_wait_seconds.increment(t2 - t0)
     return out
 
 

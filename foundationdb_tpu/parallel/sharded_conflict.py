@@ -73,6 +73,25 @@ def shard_cut_bytes(n_shards: int) -> list[bytes]:
                     for d in range(1, n_shards)]
 
 
+def shard_cut_bytes_range(n_shards: int, begin: bytes = b"",
+                          end: bytes | None = None) -> list[bytes]:
+    """Equal cuts of the resolver's OWNED range [begin, end) — the inner
+    mesh split under an outer ResolverMap partition. cuts[0] stays b"":
+    shard 0 also absorbs the sub-`begin` space an outer-partitioned
+    resolver is never offered, so clipping stays total without a per-range
+    ownership check. `end=None` means "to the end of keyspace". Falls back
+    to whole-space cuts when the range is too narrow to cut n ways at
+    4-byte granularity (degenerate, but still correct: extra shards just
+    sit idle on keyspace the resolver never sees)."""
+    lo = int.from_bytes(begin[:4].ljust(4, b"\x00"), "big")
+    hi = (1 << 32) if end is None else int.from_bytes(
+        end[:4].ljust(4, b"\x00"), "big")
+    if hi - lo < n_shards:
+        return shard_cut_bytes(n_shards)
+    return [b""] + [(lo + (d * (hi - lo)) // n_shards).to_bytes(4, "big")
+                    for d in range(1, n_shards)]
+
+
 def shard_cut_keys(n_shards: int) -> np.ndarray:
     """(n_shards+1, L) limb vectors: shard d owns [cuts[d], cuts[d+1]).
 
@@ -218,9 +237,16 @@ def _build_sharded_step(mesh: Mesh, shapes: ConflictShapes,  # noqa: C901
 
 
 def init_sharded_state(shapes: ConflictShapes, n_shards: int, oldest: int = 0,
-                       cut_bytes: list[bytes] | None = None):
+                       cut_bytes: list[bytes] | None = None,
+                       mesh: Mesh | None = None):
     """Stacked per-shard initial states, leading axis = shard. Each shard
-    carries its owned range [lo, hi) as state (dynamic cuts)."""
+    carries its owned range [lo, hi) as state (dynamic cuts).
+
+    Pass `mesh` to place the state with the step's sharding up front:
+    default-placed leaves make jit specialize the first step call on the
+    unsharded layout and RE-specialize on its own mesh-sharded output — a
+    second full XLA compile that would otherwise land on the first SERVED
+    batch (warmup only pays for one)."""
     one = init_state(shapes, oldest=oldest)
     st = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n_shards,) + x.shape), one)
@@ -230,6 +256,11 @@ def init_sharded_state(shapes: ConflictShapes, n_shards: int, oldest: int = 0,
     cuts[n_shards, :] = 0xFFFFFFFF
     st["lo"] = jnp.asarray(cuts[:n_shards])
     st["hi"] = jnp.asarray(cuts[1:])
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from foundationdb_tpu.utils import jaxenv
+        st = jaxenv.device_put(st, NamedSharding(mesh, P(RESOLVER_AXIS)))
     return st
 
 
@@ -256,7 +287,8 @@ class ShardedDeviceConflictSet:
         self.cut_bytes = list(cut_bytes or shard_cut_bytes(self.n_shards))
         assert self.cut_bytes[0] == b"" and len(self.cut_bytes) == self.n_shards
         self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0,
-                                         cut_bytes=self.cut_bytes)
+                                         cut_bytes=self.cut_bytes,
+                                         mesh=self.mesh)
         # full sandwich rounds (T//2+1): the host-exact fallback resolves
         # intra conflicts with SINGLE-resolver semantics, which per-shard
         # "earlier txns win" + pmin does not reduce to, so the sharded
@@ -273,6 +305,9 @@ class ShardedDeviceConflictSet:
         self._load_counts = np.zeros(self.n_shards, dtype=np.int64)
         self._samples: list[int] = []  # first-4-byte ints of range begins
         self._batches_since_check = 0
+        # cuts scheduled by rebalance_from_conflicts, applied by the next
+        # detect_async (the dispatch thread owns all state restructures)
+        self._pending_cuts: list[bytes] | None = None
         self._sample_rng = np.random.RandomState(0)
         self.rebalances = 0
 
@@ -306,6 +341,10 @@ class ShardedDeviceConflictSet:
     def detect_async(self, txns: list[TxnConflictInfo], commit_version: int):
         from foundationdb_tpu.ops.conflict import detect_async_impl
 
+        if self._pending_cuts is not None:
+            cuts, self._pending_cuts = self._pending_cuts, None
+            if cuts != self.cut_bytes:
+                self.rebalance_cuts(cuts, commit_version)
         self._record_load(txns)
         self._batches_since_check += 1
         if self._batches_since_check >= KNOBS.RESOLUTION_BALANCE_CHECK_BATCHES:
@@ -317,11 +356,13 @@ class ShardedDeviceConflictSet:
         self.encoder.base_version = oldest_version
         self.oldest_version = oldest_version
         self._state = init_sharded_state(self.shapes, self.n_shards, oldest=0,
-                                         cut_bytes=self.cut_bytes)
+                                         cut_bytes=self.cut_bytes,
+                                         mesh=self.mesh)
         # stale load/samples must not drive a rebalance of the fresh state
         self._load_counts[:] = 0
         self._samples.clear()
         self._batches_since_check = 0
+        self._pending_cuts = None
 
     # -- resolutionBalancing --
 
@@ -371,6 +412,67 @@ class ShardedDeviceConflictSet:
                 return False  # degenerate sample (mass on one prefix): keep cuts
             new_cuts.append(cb)
         self.rebalance_cuts(new_cuts, at_version)
+        return True
+
+    def rebalance_from_conflicts(self, ranges) -> bool:
+        """Conflict-mass-driven recut, the cross-epoch resolutionBalancing
+        analogue: `ranges` is [(begin, end, rate)] from the resolver role's
+        HotRangeSketch — per-range exponentially-decayed CONFLICT mass.
+        Where maybe_rebalance recuts on raw read/write traffic, this path
+        recuts on where aborts actually land, so a conflict-hot shard sheds
+        keyspace even when range counts look balanced.
+
+        Pure host numpy: it only PLANS and schedules the cuts (safe to call
+        from the resolver's event loop — no device sync, devlint DEV001);
+        detect_async applies the restructure at the next batch boundary on
+        the dispatch path, so cuts never move under an in-flight batch.
+        Same safety story as the load path: rebalance_cuts's conservative
+        fill can only create false conflicts. Returns True iff a recut was
+        scheduled."""
+        if not ranges:
+            return False
+        prefs = np.array(
+            [int.from_bytes(b[:4].ljust(4, b"\x00"), "big")
+             for b, _e, _r in ranges], dtype=np.float64)
+        mass = np.array([r for _b, _e, r in ranges], dtype=np.float64)
+        total = float(mass.sum())
+        if total <= 0.0:
+            return False
+        cut_pref = np.array(
+            [int.from_bytes(cb[:4].ljust(4, b"\x00"), "big")
+             for cb in self.cut_bytes], dtype=np.float64)
+        shard_idx = np.searchsorted(cut_pref, prefs, side="right") - 1
+        per_shard = np.zeros(self.n_shards, dtype=np.float64)
+        np.add.at(per_shard, shard_idx, mass)
+        skew = KNOBS.RESOLUTION_BALANCE_SKEW * (total / self.n_shards)
+        if per_shard.max() <= skew:
+            return False
+        # weighted-quantile cuts: sort hot ranges by key prefix, cut where
+        # cumulative conflict mass crosses each d/n target
+        order = np.argsort(prefs, kind="stable")
+        cum = np.cumsum(mass[order])
+        targets = [total * d / self.n_shards
+                   for d in range(1, self.n_shards)]
+        idxs = np.searchsorted(cum, targets, side="left")
+        sorted_prefs = prefs[order]
+        new_cuts = [b""]
+        for i in idxs:
+            i = min(int(i), len(order) - 1)
+            cb = int(sorted_prefs[i]).to_bytes(4, "big")
+            while cb <= new_cuts[-1]:
+                # target landed on/behind the previous cut (mass front-
+                # loaded on few ranges): advance to the next distinct hot
+                # prefix so a dominant range still gets isolated. Running
+                # out means the mass sits on ONE prefix — a DD shard-split
+                # problem, not a resolver cut problem; keep the cuts.
+                i += 1
+                if i >= len(order):
+                    return False
+                cb = int(sorted_prefs[i]).to_bytes(4, "big")
+            new_cuts.append(cb)
+        if new_cuts == self.cut_bytes:
+            return False
+        self._pending_cuts = new_cuts
         return True
 
     def rebalance_cuts(self, new_cut_bytes: list[bytes], at_version: int):

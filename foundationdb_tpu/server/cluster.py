@@ -60,8 +60,17 @@ class SimCluster:
 
         # -- role state --
         self.master = Master(self.master_proc)
-        self.resolvers = [Resolver(p, n_proxies=n_proxies)
-                          for p in self.resolver_procs]
+        # outer key split: resolver i owns [rb[i], rb[i+1]); the sharded
+        # backend's mesh cuts subdivide that range (inner split), so
+        # n_resolvers > 1 topologies and the device mesh compose
+        resolver_bounds = _partition_boundaries(n_resolvers)
+        self.resolvers = [
+            Resolver(p, n_proxies=n_proxies,
+                     key_range_begin=resolver_bounds[i],
+                     key_range_end=(resolver_bounds[i + 1]
+                                    if i + 1 < len(resolver_bounds)
+                                    else None))
+            for i, p in enumerate(self.resolver_procs)]
         self.tlogs = [TLog(p) for p in self.tlog_procs]
 
         # storage sharding: shard i served by storage i (tag = i); every tlog
@@ -70,7 +79,7 @@ class SimCluster:
         shard_map = ShardMap(boundaries=self.shard_boundaries,
                              tags=[[i] for i in range(n_storage)])
         resolver_map = ResolverMap(
-            boundaries=_partition_boundaries(n_resolvers),
+            boundaries=resolver_bounds,
             endpoints=resolver_eps)
 
         def shard_range(i):

@@ -531,10 +531,17 @@ class ClusterController:
                                             uids=uids + sat_uids,
                                             n_primary=len(tlog_addrs))]
 
+        # each resolver is told its slice of the outer key split so a
+        # sharded conflict engine can cut the mesh INSIDE its range
+        resolver_bounds = _partition_boundaries(cfg.n_resolvers)
         resolver_addrs = await self._recruit_many(
             stateless, cfg.n_resolvers, "resolver",
             lambda i: {"recovery_version": start_version,
-                       "n_proxies": cfg.n_proxies})
+                       "n_proxies": cfg.n_proxies,
+                       "key_range_begin": resolver_bounds[i],
+                       "key_range_end": (resolver_bounds[i + 1]
+                                         if i + 1 < len(resolver_bounds)
+                                         else None)})
         master_addr = (await self._recruit_many(
             stateless, 1, "master",
             lambda i: {"recovery_version": start_version, "epoch": epoch,
@@ -683,7 +690,7 @@ class ClusterController:
         system_snapshot = systemdata.build_keyservers_snapshot(
             boundaries, shard_tags)
         resolver_map = ResolverMap(
-            boundaries=_partition_boundaries(cfg.n_resolvers),
+            boundaries=resolver_bounds,
             endpoints=[Endpoint(a, Token.RESOLVER_RESOLVE)
                        for a in resolver_addrs])
         # worker address == role address, so the cross-proxy GRV confirmation

@@ -747,6 +747,8 @@ class Proxy:
         t_ins = [t for _req, _rep, t in batch]
         resolution_started = False
         state_applied = False
+        version_assigned = False
+        push_initiated = False
         batch_meta: list[list | None] = []  # per request
         bid = f"b{self.proxy_id}.{batch_n}"
         now = self.loop.now
@@ -802,6 +804,7 @@ class Proxy:
                         raise  # master gone: recovery will replace us
                     await self.loop.delay(0.2)
             commit_version, prev_version = ver.version, ver.prev_version
+            version_assigned = True
             _se("Proxy.GetCommitVersion")
             # stitch the batch to its commit version: resolver + tlog spans
             # downstream carry v<version> idents
@@ -1026,6 +1029,7 @@ class Proxy:
             push_f = self.log_system.push(
                 prev_version, commit_version, messages,
                 self.committed_version.get())
+            push_initiated = True
             # release the logging gate at push INITIATION, not completion
             # (the reference releases latestLocalCommitBatchLogging before
             # waiting on the push, :426/:835): the TLogs order concurrent
@@ -1092,7 +1096,16 @@ class Proxy:
                 self._infra_failures += 1
                 state_batch_lost = (resolution_started
                                     and any(m for m in batch_meta))
+                # a batch abandoned between version assignment and push
+                # INITIATION leaves a permanent gap in the TLogs' prevVersion
+                # chain (the tlog orders pushes exactly like the resolver
+                # orders batches — see the version-fetch retry above for the
+                # resolver-side twin): every later push wedges behind the
+                # missing version until a recovery re-anchors the chain, so
+                # retry slack is doomed time — take the recovery NOW
+                tlog_chain_gapped = version_assigned and not push_initiated
                 if self.die_on_failure and (state_batch_lost
+                                            or tlog_chain_gapped
                                             or self._infra_failures >= 3):
                     # a post-resolution failure of a batch CARRYING state
                     # transactions is immediately fatal: the resolvers

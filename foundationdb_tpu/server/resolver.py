@@ -16,6 +16,7 @@ from __future__ import annotations
 from foundationdb_tpu.core.future import settle_failed
 from foundationdb_tpu.core.notified import AsyncTrigger, NotifiedVersion
 from foundationdb_tpu.core.sim import SimProcess
+from foundationdb_tpu.ops.batch import validate_conflict_config
 from foundationdb_tpu.ops.conflict import DeviceConflictSet
 from foundationdb_tpu.ops.conflict_oracle import OracleConflictSet
 from foundationdb_tpu.server.hotspot import HotRangesReply, HotRangeSketch
@@ -27,14 +28,21 @@ from foundationdb_tpu.utils.stats import CounterCollection, trace_counters_loop
 from foundationdb_tpu.utils.trace import g_trace_batch
 
 
-def new_conflict_set(oldest_version: int = 0):
+def new_conflict_set(oldest_version: int = 0,
+                     key_range: tuple[bytes, bytes | None] = (b"", None)):
     """newConflictSet() dispatch (ConflictSet.h:28) on the CONFLICT_BACKEND knob.
 
     "device"  — single-device JAX kernel
-    "sharded" — key-partitioned SPMD engine over the full device mesh
-                (parallel/sharded_conflict.py), with resolutionBalancing
-                (load-sampled cut moves) built in
+    "sharded" — key-partitioned SPMD engine over the device mesh
+                (parallel/sharded_conflict.py): CONFLICT_NUM_SHARDS devices
+                (0 = every attached device), with resolutionBalancing
+                (load-sampled + conflict-mass cut moves) built in
     "oracle"  — pure-Python CPU reference
+
+    `key_range` is the resolver's OWNED range from the outer ResolverMap
+    partition: in an n_resolvers > 1 topology the proxy's key split stays the
+    outer cut while the sharded engine's mesh subdivides [begin, end) as the
+    inner one, so the two compose instead of fighting over the keyspace.
 
     Device backends attach the accelerator lazily on their first jax call —
     which, on a wedged remote runtime, hangs with no deadline. Bound the
@@ -42,6 +50,7 @@ def new_conflict_set(oldest_version: int = 0):
     process is pinned to CPU and the engine is constructed (and labeled)
     as a cpu-fallback instead of hanging warmup()/recovery.
     """
+    validate_conflict_config()
     if KNOBS.CONFLICT_BACKEND in ("device", "sharded"):
         from foundationdb_tpu.utils.jaxenv import bound_device_discovery
         backend_label = bound_device_discovery()
@@ -61,21 +70,41 @@ def new_conflict_set(oldest_version: int = 0):
         cs.backend_label = backend_label
         return cs
     if KNOBS.CONFLICT_BACKEND == "sharded":
+        import jax
+
         from foundationdb_tpu.parallel.sharded_conflict import (
-            ShardedDeviceConflictSet)
-        cs = ShardedDeviceConflictSet(oldest_version=oldest_version)
-        cs.backend_label = backend_label
+            ShardedDeviceConflictSet, make_resolver_mesh,
+            shard_cut_bytes_range)
+        n = int(KNOBS.CONFLICT_NUM_SHARDS)
+        avail = len(jax.devices())  # discovery already bounded above
+        if n > avail:
+            raise FDBError(
+                "invalid_option",
+                f"CONFLICT_NUM_SHARDS={n} exceeds the {avail} attached "
+                f"device(s); set 0 to span all of them")
+        mesh = make_resolver_mesh(n or None)
+        cuts = shard_cut_bytes_range(mesh.devices.size,
+                                     key_range[0], key_range[1])
+        cs = ShardedDeviceConflictSet(mesh=mesh,
+                                      oldest_version=oldest_version,
+                                      cut_bytes=cuts)
+        cs.backend_label = f"{backend_label}x{mesh.devices.size}"
         return cs
     return OracleConflictSet(oldest_version=oldest_version)
 
 
 class Resolver:
     def __init__(self, process: SimProcess, recovery_version: int = 0,
-                 n_proxies: int = 1):
+                 n_proxies: int = 1, key_range_begin: bytes = b"",
+                 key_range_end: bytes | None = None):
         self.process = process
         self.n_proxies = n_proxies
+        # this resolver's slice of the outer ResolverMap partition; the
+        # sharded engine's mesh cuts subdivide it (inner split)
+        self.key_range = (key_range_begin, key_range_end)
         self.version = NotifiedVersion(recovery_version)
-        self.conflict_set = new_conflict_set(oldest_version=recovery_version)
+        self.conflict_set = new_conflict_set(oldest_version=recovery_version,
+                                             key_range=self.key_range)
         self._pipelined = hasattr(self.conflict_set, "detect_async")
         if self._pipelined:
             # Force the device programs (all serving buckets) to compile
@@ -117,6 +146,13 @@ class Resolver:
         # the snapshot via RESOLVER_HOT_RANGES
         self.hot_sketch = HotRangeSketch()
         self._c_sampled = self.counters.counter("ConflictsSampled")
+        # cross-epoch cut rebalancing (sharded engine only): the sketch's
+        # decayed per-range conflict mass drives the inner-mesh recut
+        self._c_rebalances = self.counters.counter("CutRebalances")
+        self._balance_task = (
+            process.spawn(self._balance_loop(), "resolverBalance")
+            if hasattr(self.conflict_set, "rebalance_from_conflicts")
+            else None)
         process.register(Token.RESOLVER_RESOLVE, self._on_resolve)
         process.register(Token.RESOLVER_METRICS, self._on_metrics)
         process.register(Token.RESOLVER_HOT_RANGES, self._on_hot_ranges)
@@ -127,6 +163,8 @@ class Resolver:
         self._counters_task.cancel()
         if self._drain_task is not None:
             self._drain_task.cancel()
+        if self._balance_task is not None:
+            self._balance_task.cancel()
         for t in list(self._drain_groups):
             t.cancel()
 
@@ -228,25 +266,46 @@ class Resolver:
         handles = [h for _req, _reply, h in entries]
         err = None
         results: list | None = None
+        sharded = hasattr(self.conflict_set, "rebalance_from_conflicts")
         self._c_groups.increment()
         try:
             try:
                 # drain AND materialize off-loop: result() can run the exact
                 # host intra-batch fallback on an unconverged chunk, which
                 # must not eat event-loop time (devlint DEV001)
+                timing: dict = {}
                 t_rb0 = loop.now()
                 results = await loop.run_blocking(
-                    lambda hs=handles: drain_and_collect(hs))
+                    lambda hs=handles: drain_and_collect(hs, timing))
                 # per-entry readback spans, emitted only once the wait
                 # completed (a cancel mid-drain must not leave open spans);
                 # all entries in a group share one device sync, so they
-                # share its window
+                # share its window. On the sharded backend the window is
+                # split: the device sync is ReadbackWait, the host
+                # materialization of the pmin-combined verdicts is
+                # ShardCombine (single-device unpack is negligible and
+                # stays inside ReadbackWait).
                 t_rb1 = loop.now()
+                t_split = t_rb1
+                if sharded:
+                    wall = (timing.get("drain_seconds", 0.0)
+                            + timing.get("collect_seconds", 0.0))
+                    if wall > 0.0:
+                        t_split = t_rb0 + (t_rb1 - t_rb0) * (
+                            timing["drain_seconds"] / wall)
                 for req, _reply, _h in entries:
-                    g_trace_batch.span_begin("CommitSpan", f"v{req.version}",
+                    vid = f"v{req.version}"
+                    g_trace_batch.span_begin("CommitSpan", vid,
                                              "Resolver.ReadbackWait", at=t_rb0)
-                    g_trace_batch.span_end("CommitSpan", f"v{req.version}",
-                                           "Resolver.ReadbackWait", at=t_rb1)
+                    g_trace_batch.span_end("CommitSpan", vid,
+                                           "Resolver.ReadbackWait", at=t_split)
+                    if sharded:
+                        g_trace_batch.span_begin("CommitSpan", vid,
+                                                 "Resolver.ShardCombine",
+                                                 at=t_split)
+                        g_trace_batch.span_end("CommitSpan", vid,
+                                               "Resolver.ShardCombine",
+                                               at=t_rb1)
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise  # killed/displaced mid-drain: die, don't reply
@@ -275,6 +334,27 @@ class Resolver:
             # sequencing gate, or every later drain group wedges forever on
             # when_at_least(seq - 1) (round-5 ADVICE, resolver.py:148).
             self._advance_drained(seq)
+
+    async def _balance_loop(self):
+        """Cross-epoch cut rebalancing — the resolutionBalancing analogue
+        (masterserver.actor.cpp:955-1012) driven by CONFLICT mass instead of
+        raw iops: every RESOLUTION_BALANCE_EPOCH_SECONDS the decayed
+        per-range conflict rates from the hotspot sketch feed the sharded
+        engine's cut planner. The planner only computes and SCHEDULES new
+        cuts (pure host numpy — no device sync on the loop thread, devlint
+        DEV001); the engine applies the state restructure at its next
+        dispatch, so cuts never move under an in-flight batch."""
+        loop = self.process.net.loop
+        while True:
+            await loop.delay(KNOBS.RESOLUTION_BALANCE_EPOCH_SECONDS)
+            now = loop.now()
+            self.hot_sketch.prune(now)
+            hot = self.hot_sketch.top_k(KNOBS.HOTSPOT_MAX_BUCKETS, now)
+            if not hot:
+                continue
+            ranges = [(r.begin, r.end, r.rate) for r in hot]
+            if self.conflict_set.rebalance_from_conflicts(ranges):
+                self._c_rebalances.increment()
 
     def _advance_drained(self, seq: int):
         """Advance the drain-ordering gate to `seq` without ever moving it

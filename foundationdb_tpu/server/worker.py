@@ -13,6 +13,7 @@ from foundationdb_tpu.core.sim import Endpoint, SimProcess
 from foundationdb_tpu.server.coordination import CoordToken, get_leader
 from foundationdb_tpu.server.interfaces import (
     InitRoleReply, InitRoleRequest, RegisterWorkerRequest, Token)
+from foundationdb_tpu.ops.batch import validate_conflict_config
 from foundationdb_tpu.storage.kvstore import validate_storage_engine
 from foundationdb_tpu.utils.errors import FDBError
 from foundationdb_tpu.utils.knobs import KNOBS
@@ -25,6 +26,9 @@ class Worker:
         # recruitment minutes later (openKVStore would raise eventually, but
         # only on whichever worker happens to get a storage role)
         validate_storage_engine(KNOBS.STORAGE_ENGINE)
+        # same contract for the resolver's conflict engine (jax-free check;
+        # the device-count bound is enforced at engine construction)
+        validate_conflict_config()
         self.process = process
         self.coordinators = coordinators
         self.capabilities = capabilities

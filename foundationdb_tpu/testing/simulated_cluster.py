@@ -38,11 +38,40 @@ _ACCEL_FAST_SHAPE = {
     "CONFLICT_STATE_CAPACITY": 2048,
 }
 
-# the sharded backend needs a working jax mesh; the draw default excludes it
-# so environments with a broken accelerator stack still sweep — pass
-# allow_backends=("oracle", "device", "sharded") to include it
-DEFAULT_BACKENDS = ("oracle", "device")
+DEFAULT_BACKENDS = ("oracle", "device", "sharded")
 DEFAULT_ENGINES = ("memory", "ssd", "redwood")
+
+# sharded draws must run the real SPMD mesh even on the CPU platform
+# (CPU_FALLBACK="host" would silently degrade them to the host oracle and
+# the sim would never exercise the shard_map path); 2 shards keeps the
+# mesh program small while still crossing a cut boundary, and the sweep's
+# conftest-forced host device count (8) always covers it
+_SHARDED_SIM_SHAPE = {
+    "CONFLICT_NUM_SHARDS": 2,
+    "CONFLICT_CPU_FALLBACK": "jax",
+}
+
+
+def _ensure_mesh_devices():
+    """Sharded draws need CONFLICT_NUM_SHARDS jax devices. Under pytest the
+    conftest forces 8 host-platform CPU devices; a CLI repro process must
+    force them here instead — possible only before jax initializes. If jax
+    is already imported with fewer devices, shrink the mesh width instead:
+    a 1-wide mesh still exercises the shard_map path, and decisions are
+    identical at any width."""
+    import os
+    import sys
+    if "jax" not in sys.modules:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=8")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        return
+    import jax
+    avail = len(jax.devices())
+    if 0 < avail < int(KNOBS.CONFLICT_NUM_SHARDS):
+        KNOBS.set("CONFLICT_NUM_SHARDS", avail)
 
 # redwood draws shrink the engine's budgets so test-scale datasets actually
 # flush and compact (at the production defaults a 25s spec never fills the
@@ -158,6 +187,10 @@ class ClusterDraw:
         if self.conflict_backend in ("device", "sharded"):
             for k, v in _ACCEL_FAST_SHAPE.items():
                 KNOBS.set(k, v)
+        if self.conflict_backend == "sharded":
+            for k, v in _SHARDED_SIM_SHAPE.items():
+                KNOBS.set(k, v)
+            _ensure_mesh_devices()
         if self.storage_engine == "redwood":
             for k, v in _REDWOOD_SIM_SHAPE.items():
                 KNOBS.set(k, v)

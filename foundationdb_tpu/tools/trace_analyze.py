@@ -129,6 +129,36 @@ def queueing_ratio(stages: dict) -> float | None:
     return round(client["p50"] / server, 2)
 
 
+def readback_overlap_ratio(spans) -> float | None:
+    """How much of the device→host verdict readback hides under subsequent
+    dispatches. Per batch (ident): the D2H copy is in flight from the end
+    of its Resolver.Dispatch until its Resolver.ReadbackWait begins —
+    hidden time, the resolver was dispatching other batches — while the
+    ReadbackWait span itself is the exposed stall. hidden/(hidden+exposed)
+    over all batches: 1.0 = readback fully overlapped with dispatch, 0.0 =
+    every copy is a synchronous stall (CONFLICT_READBACK_OVERLAP=False).
+    None when the trace carries no readback spans (oracle backend)."""
+    dispatch_end: dict[str, float] = {}
+    for s in spans:
+        if s["Span"] == "Resolver.Dispatch":
+            prev = dispatch_end.get(s["ID"])
+            dispatch_end[s["ID"]] = s["End"] if prev is None \
+                else min(prev, s["End"])
+    hidden = exposed = 0.0
+    seen = False
+    for s in spans:
+        if s["Span"] != "Resolver.ReadbackWait":
+            continue
+        seen = True
+        exposed += s["Duration"]
+        de = dispatch_end.get(s["ID"])
+        if de is not None:
+            hidden += max(0.0, s["Start"] - de)
+    if not seen or hidden + exposed <= 0.0:
+        return None
+    return round(hidden / (hidden + exposed), 4)
+
+
 def stage_stats(spans) -> dict:
     """Per-stage residency: {span_name: {n, p50, p99, total}} seconds."""
     by_stage: dict[str, list[float]] = {}
@@ -240,6 +270,7 @@ def analyze(events) -> dict:
         "flows": len(flows),
         "stages": stages,
         "queueing_ratio": queueing_ratio(stages),
+        "readback_overlap_ratio": readback_overlap_ratio(spans),
         "contention": contention_stats(events),
     }
 
@@ -256,6 +287,10 @@ def format_report(report: dict) -> str:
     if qr is not None:
         lines.append(f"queueing_ratio (Client.Commit p50 / server stages "
                      f"p50 sum): {qr:.2f}")
+    ror = report.get("readback_overlap_ratio")
+    if ror is not None:
+        lines.append(f"readback_overlap_ratio (hidden under dispatch / "
+                     f"total readback): {ror:.4f}")
     con = report.get("contention")
     if con and con["commits_in"]:
         lines.append(

@@ -42,6 +42,9 @@ _ERRORS = {
     "key_outside_legal_range": (2003, False),
     "inverted_range": (2004, False),
     "invalid_option_value": (2006, False),
+    # bad knob/config at role boot (validate_storage_engine,
+    # validate_conflict_config): fail fast, never fall back silently
+    "invalid_option": (2007, False),
     "used_during_commit": (2017, True),
     "invalid_mutation_type": (2048, False),
     "key_too_large": (2102, False),

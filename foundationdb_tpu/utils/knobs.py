@@ -108,6 +108,11 @@ KNOBS.init("COMMIT_PIPELINE_DEPTH", 4, (1,))
 
 # --- Conflict engine (device) ---
 KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "sharded" (mesh) | "oracle" (CPU reference)
+# Mesh width for CONFLICT_BACKEND=sharded: how many devices the resolver's
+# key-partitioned engine spans. 0 = every attached device (the production
+# setting on a full slice); validated at worker boot like STORAGE_ENGINE
+# and against the attached device count at engine construction.
+KNOBS.init("CONFLICT_NUM_SHARDS", 0, (1, 2))
 # resolutionBalancing analogue (masterserver.actor.cpp:955-1012): the sharded
 # engine re-cuts its key partition from sampled range begins when per-shard
 # load skews. Checked every N batches; rebalances when the hottest shard
@@ -115,6 +120,16 @@ KNOBS.init("CONFLICT_BACKEND", "device")  # "device" (JAX) | "sharded" (mesh) | 
 KNOBS.init("RESOLUTION_BALANCE_CHECK_BATCHES", 64, (4,))
 KNOBS.init("RESOLUTION_BALANCE_SKEW", 2.0)
 KNOBS.init("RESOLUTION_BALANCE_MIN_SAMPLES", 2048, (32,))
+# Cross-epoch cut rebalancing: the resolver role feeds its HotRangeSketch
+# (per-range decayed conflict mass) into the sharded engine every EPOCH
+# seconds — conflict-mass-driven cuts on top of the load-sample path above.
+KNOBS.init("RESOLUTION_BALANCE_EPOCH_SECONDS", 5.0, (0.5,))
+# Double-buffered device readback (docs/conflict_kernel.md): batch N's D2H
+# verdict copy is started at dispatch and overlaps batch N+1's encode +
+# dispatch. False = fully synchronous readback (the pre-overlap shape, kept
+# as an ablation for the ReadbackWait residency bench and as a buggify axis:
+# decisions are identical, only timing shifts).
+KNOBS.init("CONFLICT_READBACK_OVERLAP", True, (False,))
 KNOBS.init("CONFLICT_STATE_CAPACITY", 1 << 16, (1 << 10,))  # boundary slots
 KNOBS.init("CONFLICT_BATCH_TXNS", 1024)  # static batch shape: txns
 KNOBS.init("CONFLICT_BATCH_READS_PER_TXN", 4)

@@ -181,6 +181,140 @@ class _SafetyTracker:
                     self.writes.append((wb, we, version))
 
 
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+def test_decision_parity_across_device_counts(n_devices):
+    """The resolver may be handed any mesh width (CONFLICT_NUM_SHARDS):
+    verdicts at EVERY width must match the N-clipped-oracle min-combine
+    model, and a 1-wide mesh must agree with the single-device evaluator
+    exactly (no cuts -> no clipping -> no retention divergence)."""
+    from foundationdb_tpu.parallel.sharded_conflict import shard_cut_bytes
+
+    mesh = make_resolver_mesh(n_devices)
+    cuts = shard_cut_bytes(n_devices)
+    sharded = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=256, txns=16, reads_per_txn=4, writes_per_txn=4)
+    single = DeviceConflictSet(
+        capacity=256, txns=16, reads_per_txn=4, writes_per_txn=4)
+    oracles = [OracleConflictSet() for _ in range(n_devices)]
+    for txns, version in _random_batches(
+            seed=3, n_batches=10, txns_per_batch=10):
+        got = sharded.detect(txns, version)
+        want = _sharded_oracle_detect(oracles, cuts, txns, version)
+        assert got == want
+        base = single.detect(txns, version)
+        if n_devices == 1:
+            assert got == base
+        else:
+            for g, b in zip(got, base):
+                if g == COMMITTED:
+                    assert b == COMMITTED  # no false commits at any width
+
+
+def test_safe_false_conflict_at_shard_cut():
+    """The documented divergence between sharded and single-resolver
+    semantics, pinned as a deterministic case: a txn aborted by a conflict
+    on shard 0 still has its shard-1 write retained THERE (shards don't
+    exchange abort decisions mid-batch), so a later txn in the same batch
+    reading that range gets a conservative intra-batch CONFLICT where the
+    single-device engine commits. Safe (false conflict), never the reverse
+    (false commit)."""
+    mesh = make_resolver_mesh(8)
+    sharded = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=64, txns=4, reads_per_txn=2, writes_per_txn=2)
+    single = DeviceConflictSet(
+        capacity=64, txns=4, reads_per_txn=2, writes_per_txn=2)
+    # seed history: commit a write on shard 0 at version 10
+    seedw = [TxnConflictInfo(read_snapshot=0,
+                             write_ranges=[(b"\x10", b"\x11")])]
+    assert sharded.detect(seedw, 10) == [COMMITTED]
+    assert single.detect(seedw, 10) == [COMMITTED]
+    # txn0: stale read of that range (-> CONFLICT, decided on shard 0) plus
+    # a write on shard 1 (first byte 0x30 >= cut_1 = 0x20); txn1: fresh read
+    # of txn0's shard-1 write range
+    batch = [
+        TxnConflictInfo(read_snapshot=5,
+                        read_ranges=[(b"\x10", b"\x11")],
+                        write_ranges=[(b"\x30", b"\x31")]),
+        TxnConflictInfo(read_snapshot=10,
+                        read_ranges=[(b"\x30", b"\x31")]),
+    ]
+    assert single.detect(batch, 20) == [CONFLICT, COMMITTED]
+    got = sharded.detect(batch, 20)
+    assert got[0] == CONFLICT
+    # shard 1 never learns txn0 aborted: its retained write forces the
+    # conservative verdict on txn1
+    assert got[1] == CONFLICT
+
+
+def test_conflict_config_validation():
+    """validate_conflict_config (worker/resolver boot): unknown backend and
+    malformed shard counts fail closed with invalid_option, like
+    validate_storage_engine."""
+    from foundationdb_tpu.ops.batch import validate_conflict_config
+    from foundationdb_tpu.utils.errors import FDBError
+
+    validate_conflict_config("sharded", 0)
+    validate_conflict_config("oracle", 8)
+    for bad in ("skiplist", "", "SHARDED"):
+        with pytest.raises(FDBError) as ei:
+            validate_conflict_config(bad, 0)
+        assert ei.value.name == "invalid_option"
+    for bad_n in (-1, 2.5, "4", True):
+        with pytest.raises(FDBError):
+            validate_conflict_config("sharded", bad_n)
+
+
+def test_num_shards_over_device_count_is_rejected():
+    """CONFLICT_NUM_SHARDS beyond the attached device count must fail at
+    role boot (resolver), not at first dispatch."""
+    from foundationdb_tpu.server.resolver import new_conflict_set
+    from foundationdb_tpu.utils.errors import FDBError
+    from foundationdb_tpu.utils.knobs import KNOBS
+
+    KNOBS.overrides(CONFLICT_BACKEND="sharded", CONFLICT_NUM_SHARDS=99,
+                    CONFLICT_CPU_FALLBACK="jax")
+    try:
+        with pytest.raises(FDBError) as ei:
+            new_conflict_set()
+        assert ei.value.name == "invalid_option"
+    finally:
+        KNOBS.overrides(CONFLICT_BACKEND="oracle", CONFLICT_NUM_SHARDS=0,
+                        CONFLICT_CPU_FALLBACK="host")
+
+
+def test_rebalance_from_conflicts_schedules_cuts():
+    """Conflict-mass recut (the balance loop's planner): skewed hot-range
+    mass must schedule new cuts that are applied at the NEXT batch, and the
+    engine stays safe across the move. Mass concentrated on one prefix
+    cannot be split and must be declined."""
+    mesh = make_resolver_mesh(4)
+    cs = ShardedDeviceConflictSet(
+        mesh=mesh, capacity=128, txns=8, reads_per_txn=2, writes_per_txn=2)
+    before = list(cs.cut_bytes)
+    # all conflict mass on three prefixes inside shard 0
+    hot = [(b"\x01", b"\x02", 50.0), (b"\x02", b"\x03", 30.0),
+           (b"\x03", b"\x04", 20.0)]
+    assert cs.rebalance_from_conflicts(hot) is True
+    assert cs.cut_bytes == before  # scheduled, not yet applied
+    tracker = _SafetyTracker()
+    version = 100
+    txns = [TxnConflictInfo(read_snapshot=90,
+                            read_ranges=[(b"\x01a", b"\x01b")],
+                            write_ranges=[(b"\x02a", b"\x02b")])]
+    statuses = cs.detect(txns, version)
+    tracker.check_and_apply(txns, statuses, version)
+    assert cs.cut_bytes != before  # applied at the batch boundary
+    assert cs.rebalances >= 1
+    # post-move decisions stay safe and fresh reads commit
+    got = cs.detect([TxnConflictInfo(read_snapshot=version,
+                                     read_ranges=[(b"\x02a", b"\x02b")])],
+                    version + 10)
+    assert got == [COMMITTED]
+    # degenerate: every unit of mass on ONE prefix -> cannot split
+    assert cs.rebalance_from_conflicts(
+        [(b"\x05", b"\x05\x01", 100.0)]) is False
+
+
 def test_rebalance_moves_cuts_and_stays_safe():
     """A skewed workload (all load in one shard) must trigger
     resolutionBalancing; decisions afterwards may be conservative but never
