@@ -25,13 +25,14 @@ def _family_set(family: str) -> set[str] | None:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m foundationdb_tpu.analysis",
-        description="flowlint/devlint/protolint: actor-discipline, "
-                    "determinism, device-discipline and protocol-"
-                    "conformance analyzer")
+        description="flowlint/devlint/protolint/natlint: actor-discipline, "
+                    "determinism, device-discipline, protocol-conformance "
+                    "and native-C analyzer")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze (default: the "
                              "foundationdb_tpu package + repo scripts/)")
-    parser.add_argument("--family", choices=("flow", "dev", "proto", "all"),
+    parser.add_argument("--family",
+                        choices=flowlint.FAMILIES + ("all",),
                         default="all",
                         help="rule family to run (default: all)")
     parser.add_argument("--format", choices=("text", "json", "github"),

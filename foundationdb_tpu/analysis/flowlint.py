@@ -8,7 +8,7 @@ package-level pass for interprocedural rules — and diffs the findings
 against a checked-in baseline of documented grandfathered violations, so
 every new violation fails tier-1 the moment it is written.
 
-Three rule families ride the engine:
+Four rule families ride the engine:
   - flow (rules.py, FLOW001..FLOW006): actor discipline & determinism,
     enforced by tests/test_flowlint.py.
   - dev (devlint.py, DEV001..DEV008): JAX/device discipline on the hot
@@ -17,6 +17,11 @@ Three rule families ride the engine:
   - proto (protolint.py, PROTO001..PROTO008): protocol conformance on the
     RPC/wire layer (token routing, reply-on-all-paths, Python<->C schema
     parity), enforced by tests/test_protolint.py.
+  - nat (natlint.py, NAT001..NAT007): native C-extension discipline over
+    native/fdb_native.c itself (refcount balance on goto ladders, bounds
+    checks, decoded-count validation), via the csource.py C front-end;
+    enforced by tests/test_natlint.py alongside the ASan/UBSan fuzz
+    harness (scripts/build_native.sh --sanitize).
 
 Engine pieces:
   - Finding: one violation, with a line-number-independent identity key
@@ -55,16 +60,18 @@ PACKAGE_NAME = "foundationdb_tpu"
 # the simulated-cluster workloads — sim-visible code in every sense.
 SIM_VISIBLE = ("core", "server", "net", "testing")
 
-FAMILIES = ("flow", "dev", "proto")
+FAMILIES = ("flow", "dev", "proto", "nat")
 
 
 def rule_family(code: str) -> str:
-    """Family of a rule code: DEV* -> "dev", PROTO* -> "proto", everything
-    else -> "flow"."""
+    """Family of a rule code: DEV* -> "dev", PROTO* -> "proto", NAT* ->
+    "nat", everything else -> "flow"."""
     if code.startswith("DEV"):
         return "dev"
     if code.startswith("PROTO"):
         return "proto"
+    if code.startswith("NAT"):
+        return "nat"
     return "flow"
 
 
@@ -243,7 +250,7 @@ def register(cls: type[Rule]) -> type[Rule]:
 def active_rules(family: str = "all") -> list[Rule]:
     # importing the rule modules populates the registry
     from foundationdb_tpu.analysis import (  # noqa: F401
-        devlint, protolint, rules)
+        devlint, natlint, protolint, rules)
     out = [cls() for cls in sorted(_REGISTRY, key=lambda c: c.code)]
     if family != "all":
         out = [r for r in out if r.family == family]

@@ -10,6 +10,10 @@ Usage:
     from foundationdb_tpu import native
     if native.available():
         native.mod.encode_keys_into(keys, buf, round_up)
+
+Set FDBTPU_NATIVE_SO=/path/to/fdb_native.so to load a pre-built shared
+object instead of compiling (scripts/build_native.sh --sanitize uses this
+to run the package against an ASan/UBSan-instrumented build).
 """
 
 from __future__ import annotations
@@ -43,13 +47,21 @@ def _build() -> str | None:
 
 def _load():
     global mod, _build_error
-    if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-        _build_error = _build()
-        if _build_error is not None:
+    override = os.environ.get("FDBTPU_NATIVE_SO")
+    if override:
+        if not os.path.exists(override):
+            _build_error = f"FDBTPU_NATIVE_SO does not exist: {override}"
             return
-    spec = importlib.util.spec_from_file_location("fdb_native", _SO)
+        so = override
+    else:
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            _build_error = _build()
+            if _build_error is not None:
+                return
+        so = _SO
+    spec = importlib.util.spec_from_file_location("fdb_native", so)
     m = importlib.util.module_from_spec(spec)
     try:
         spec.loader.exec_module(m)

@@ -79,7 +79,16 @@ static PyObject *py_crc32c(PyObject *self, PyObject *args) {
     unsigned int init = 0;
     if (!PyArg_ParseTuple(args, "y*|I", &data, &init))
         return NULL;
-    uint32_t crc = crc32c_sw(init, (const uint8_t *)data.buf, data.len);
+    uint32_t crc;
+    if (data.len >= (Py_ssize_t)(64 * 1024)) {
+        /* transport checksums whole frames; beyond the save/restore cost
+         * crossover, let other threads run for the duration of the pass */
+        Py_BEGIN_ALLOW_THREADS
+        crc = crc32c_sw(init, (const uint8_t *)data.buf, data.len);
+        Py_END_ALLOW_THREADS
+    } else {
+        crc = crc32c_sw(init, (const uint8_t *)data.buf, data.len);
+    }
     PyBuffer_Release(&data);
     return PyLong_FromUnsignedLong(crc);
 }
@@ -203,6 +212,10 @@ static PyObject *py_redwood_decode_block(PyObject *self, PyObject *arg) {
     memcpy(&crc, b + 12, 4);
     if (magic != REDWOOD_BLOCK_MAGIC || (Py_ssize_t)plen != data.len - 16 ||
         crc32c_sw(0, b + 16, plen) != crc)
+        goto corrupt;
+    /* every entry costs at least its 8-byte header: reject a corrupt count
+     * before it sizes the output list */
+    if (n > plen / 8)
         goto corrupt;
     {
         PyObject *out = PyList_New(n);
@@ -550,6 +563,8 @@ static int enc_value(WBuf *w, PyObject *obj, int depth) {
         g_by_type ? PyDict_GetItem(g_by_type, (PyObject *)tp) : NULL;
     if (idobj) {
         uint64_t tid = (uint64_t)PyLong_AsUnsignedLongLong(idobj);
+        if (tid == (uint64_t)-1 && PyErr_Occurred())
+            return -1; /* registry id not an int-like: report, don't emit */
         if (PyLong_Check(obj)) { /* IntEnum */
             long long v = PyLong_AsLongLong(obj);
             if (v == -1 && PyErr_Occurred())
@@ -930,6 +945,7 @@ static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
                           &valid, &base_version))
         return NULL;
     PyObject *seq = NULL;
+    PyObject *skipf = NULL;
     PyObject *ret = NULL;
     if (check_key_bytes(key_bytes) < 0)
         goto done;
@@ -956,9 +972,20 @@ static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
         PyErr_SetString(PyExc_ValueError, "snap/valid buffers too small");
         goto done;
     }
+    if (skip != Py_None) {
+        skipf = PySequence_Fast(skip, "skip must be a sequence");
+        if (!skipf)
+            goto done;
+    }
+    /* the skip mask is indexed by t below: a short one would read past
+     * its item array, not raise */
+    if (skipf && PySequence_Fast_GET_SIZE(skipf) < n) {
+        PyErr_SetString(PyExc_ValueError, "skip mask shorter than txns");
+        goto done;
+    }
     for (Py_ssize_t t = 0; t < n; t++) {
-        if (skip != Py_None) {
-            int truth = PyObject_IsTrue(PySequence_Fast_GET_ITEM(skip, t));
+        if (skipf) {
+            int truth = PyObject_IsTrue(PySequence_Fast_GET_ITEM(skipf, t));
             if (truth < 0)
                 goto done;
             if (truth)
@@ -1034,6 +1061,7 @@ static PyObject *py_encode_conflict_ranges(PyObject *self, PyObject *args) {
     ret = Py_BuildValue("(nn)", ri, wi);
 done:
     Py_XDECREF(seq);
+    Py_XDECREF(skipf);
     PyBuffer_Release(&rb);
     PyBuffer_Release(&re);
     PyBuffer_Release(&wb);

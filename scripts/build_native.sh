@@ -4,15 +4,40 @@
 # Builds the extension from scratch into a throwaway directory (never the
 # package dir — CI must not clobber the lazily-built fdb_native.so other
 # tests may be using) and import-checks the symbols the Python side
-# dispatches on. Exit codes:
-#   0  — built and imported cleanly
-#   75 — no C compiler on PATH (EX_TEMPFAIL: callers skip, not fail)
-#   1  — compile or import failed (a real regression)
+# dispatches on.
+#
+#   scripts/build_native.sh                                # compile smoke
+#   scripts/build_native.sh --sanitize=address,undefined   # ASan/UBSan run
+#
+# --sanitize builds an instrumented variant (-g -O1 -fsanitize=...) and
+# re-runs the three parity fuzzes (VStore read path, redwood block codec,
+# transport framing) against it via scripts/native_sanitize_fuzz.py, with
+# the sanitizer runtimes LD_PRELOADed into the uninstrumented python and
+# PYTHONMALLOC=malloc so the extension's heap traffic is fully shadowed.
+#
+# Exit codes:
+#   0  — built and checked cleanly
+#   75 — no C compiler / no sanitizer support on this host (EX_TEMPFAIL:
+#        callers skip, not fail)
+#   1  — compile, import, parity, or sanitizer failure (a real regression)
 set -eu
 
 REPO_DIR=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
 SRC="$REPO_DIR/foundationdb_tpu/native/fdb_native.c"
 CC=${CC:-cc}
+
+SANITIZE=""
+for arg in "$@"; do
+    case "$arg" in
+        --sanitize)
+            SANITIZE="address,undefined" ;;
+        --sanitize=*)
+            SANITIZE="${arg#--sanitize=}" ;;
+        *)
+            echo "build_native: unknown argument '$arg'" >&2
+            exit 2 ;;
+    esac
+done
 
 if ! command -v "$CC" >/dev/null 2>&1; then
     echo "build_native: no C compiler ('$CC') on PATH — skipping" >&2
@@ -24,6 +49,47 @@ trap 'rm -rf "$TMPDIR_BUILD"' EXIT
 
 INCLUDE=$(python3 -c "import sysconfig; print(sysconfig.get_paths()['include'])")
 SO="$TMPDIR_BUILD/fdb_native.so"
+
+if [ -n "$SANITIZE" ]; then
+    # Probe sanitizer support: some toolchains have the flag but ship no
+    # runtime. A failed probe is an environment gap, not a regression.
+    cat > "$TMPDIR_BUILD/probe.c" <<'EOF'
+int main(void) { return 0; }
+EOF
+    if ! "$CC" -fsanitize="$SANITIZE" "$TMPDIR_BUILD/probe.c" \
+            -o "$TMPDIR_BUILD/probe" 2>/dev/null; then
+        echo "build_native: $CC cannot link -fsanitize=$SANITIZE — skipping" >&2
+        exit 75
+    fi
+
+    # The shared sanitizer runtimes must be preloadable into an
+    # uninstrumented python; static-only installs can't do that.
+    PRELOAD=""
+    for rt in libasan.so libubsan.so; do
+        lib=$("$CC" -print-file-name="$rt")
+        case "$lib" in
+            /*) PRELOAD="$PRELOAD $lib" ;;
+            *)  echo "build_native: no shared $rt runtime — skipping" >&2
+                exit 75 ;;
+        esac
+    done
+    PRELOAD=${PRELOAD# }
+
+    "$CC" -g -O1 -fno-omit-frame-pointer -shared -fPIC \
+        -fsanitize="$SANITIZE" -Wall -I"$INCLUDE" "$SRC" -o "$SO"
+
+    echo "build_native: sanitized build OK, running parity fuzzes" >&2
+    # exitcode=99 distinguishes a sanitizer report from an ordinary python
+    # failure; abort_on_error=0 so the exitcode (not SIGABRT) surfaces.
+    LD_PRELOAD="$PRELOAD" \
+    PYTHONMALLOC=malloc \
+    FDBTPU_NATIVE_SO="$SO" \
+    ASAN_OPTIONS="exitcode=99:detect_leaks=1:abort_on_error=0" \
+    UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1" \
+    python3 "$REPO_DIR/scripts/native_sanitize_fuzz.py"
+    echo "build_native: sanitize OK"
+    exit 0
+fi
 
 "$CC" -O2 -shared -fPIC -Wall -I"$INCLUDE" "$SRC" -o "$SO"
 

@@ -1,0 +1,65 @@
+"""Row-alignment gate: the newest CHANGES.md row must match ISSUE.md.
+
+Every PR appends exactly one `PR <n>: ...` line to CHANGES.md, where <n> is
+the number in ISSUE.md's `# ISSUE <n>` header. PRs 7/9/12 each shipped with
+a stale or placeholder row that the next session had to backfill; this check
+(run by scripts/lint.sh and tier-1) fails the moment the newest row and the
+issue number disagree, so the papercut cannot recur.
+
+Exit codes: 0 aligned (or no ISSUE.md to align against), 1 misaligned or a
+file is unparseable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def issue_number(text: str) -> int | None:
+    m = re.search(r"^#\s*ISSUE\s+(\d+)\b", text, re.M)
+    return int(m.group(1)) if m else None
+
+
+def newest_changes_row(text: str) -> int | None:
+    rows = re.findall(r"^PR\s+(\d+):", text, re.M)
+    return int(rows[-1]) if rows else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    issue_path = argv[0] if argv else os.path.join(REPO, "ISSUE.md")
+    changes_path = argv[1] if len(argv) > 1 else os.path.join(REPO,
+                                                             "CHANGES.md")
+    if not os.path.exists(issue_path):
+        print("changes_check: no ISSUE.md — nothing to align", file=sys.stderr)
+        return 0
+    with open(issue_path, encoding="utf-8") as f:
+        issue = issue_number(f.read())
+    if issue is None:
+        print(f"changes_check: {issue_path} has no '# ISSUE <n>' header",
+              file=sys.stderr)
+        return 1
+    if not os.path.exists(changes_path):
+        print(f"changes_check: {changes_path} missing while ISSUE {issue} "
+              f"is in flight", file=sys.stderr)
+        return 1
+    with open(changes_path, encoding="utf-8") as f:
+        row = newest_changes_row(f.read())
+    if row != issue:
+        print(f"changes_check: newest CHANGES.md row is "
+              f"{'PR %d' % row if row is not None else 'absent'} but the "
+              f"current issue is ISSUE {issue} — append this PR's "
+              f"'PR {issue}: ...' row (placeholder backfills are how "
+              f"PR-7/9/12 drifted)", file=sys.stderr)
+        return 1
+    print(f"changes_check: CHANGES.md row PR {row} matches ISSUE {issue}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# Repo lint gate: all three rule families (flow, dev, proto) over the
-# default target set (foundationdb_tpu/ + scripts/), then baseline drift
-# detection — with ONE merged exit code, so CI reports every failing gate
-# in a single run instead of stopping at the first.
+# Repo lint gate: all four rule families (flow, dev, proto, nat) over the
+# default target set (foundationdb_tpu/ + scripts/ + native/fdb_native.c),
+# then baseline drift detection, then the CHANGES.md row-alignment check —
+# with ONE merged exit code, so CI reports every failing gate in a single
+# run instead of stopping at the first.
 #
 #   scripts/lint.sh             # human output
 #   scripts/lint.sh --github    # ::error annotations for CI runners
@@ -27,4 +28,5 @@ python -m foundationdb_tpu.analysis --family all --format "$FORMAT" \
     || status=$?
 python -m foundationdb_tpu.analysis --family all --update-baseline --check \
     || status=$?
+python scripts/changes_check.py || status=$?
 exit "$status"

@@ -1,0 +1,88 @@
+"""Re-run the three C-extension parity fuzzes against a sanitized build.
+
+Launched by `scripts/build_native.sh --sanitize=...` inside an environment
+where the instrumented fdb_native.so is forced in via FDBTPU_NATIVE_SO and
+the sanitizer runtimes are LD_PRELOADed (python itself is uninstrumented, so
+the interceptors must be loaded first). PYTHONMALLOC=malloc routes CPython
+allocations through the ASan allocator so heap overflows in the extension
+are caught at the exact byte.
+
+The fuzz bodies are imported straight from the tier-1 test modules — this
+harness must never fork its own variants, or sanitizer coverage would drift
+from what parity CI actually checks. Only modules outside the jax import
+closure may be touched here: loading jaxlib under ASan drowns the run in
+third-party noise.
+
+Exits 0 on success. Any sanitizer report aborts the process with the
+ASAN_OPTIONS exitcode; a parity failure raises and exits nonzero.
+"""
+
+import ctypes
+import gc
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    override = os.environ.get("FDBTPU_NATIVE_SO")
+
+    from foundationdb_tpu import native
+    if not native.available():
+        print(f"sanitize_fuzz: native module unavailable: "
+              f"{native.build_error()}", file=sys.stderr)
+        return 1
+    if override and native.mod.__spec__.origin != override:
+        print(f"sanitize_fuzz: loaded {native.mod.__spec__.origin}, "
+              f"expected override {override}", file=sys.stderr)
+        return 1
+
+    # 1. VStore read path: mutation/GC/rollback interleavings, every read
+    #    surface cross-checked against the pure-Python VersionedMap, plus
+    #    the wire frames the C store emits directly.
+    from tests import test_vstore_parity as TV
+    if not TV.HAVE_NATIVE:
+        print("sanitize_fuzz: build lacks VStore", file=sys.stderr)
+        return 1
+    for seed in (1, 2, 3):
+        TV.test_vstore_parity_fuzz(seed)
+    TV.test_vstore_too_old_parity()
+    for seed in (11, 12):
+        TV.test_vstore_encoded_reply_parity(seed)
+    print("sanitize_fuzz: vstore parity OK")
+
+    # 2. Redwood block codec: byte-identical encode parity plus decode of
+    #    the Python encoder's output (the cross-decode is where a C bounds
+    #    bug would read past the payload).
+    from tests import test_redwood as TR
+    TR.test_block_codec_c_python_parity()
+    print("sanitize_fuzz: redwood codec parity OK")
+
+    # 3. Transport framing: wire.loads/dumps dispatch to the C codec when
+    #    available, so the mutated/random-frame fuzz drives wire_loads over
+    #    thousands of hostile inputs — the untrusted-input surface.
+    from tests import test_wire as TW
+    TW.test_decoder_fuzz_never_crashes()
+    TW.test_hostile_frames_raise_wireerror_only()
+    TW.test_container_bound()
+    print("sanitize_fuzz: transport framing fuzz OK")
+
+    # Leak check now, then skip interpreter finalization: CPython teardown
+    # frees in an order that would re-trigger interceptors for no extra
+    # coverage. gc.collect() first so dead reference cycles created by the
+    # fuzzes don't show up as C-extension leaks.
+    gc.collect()
+    try:
+        ctypes.CDLL(None).__lsan_do_leak_check()
+    except AttributeError:
+        pass  # leak checking disabled or runtime without LSan
+    print("sanitize_fuzz: no sanitizer reports")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,13 @@
 builds fdb_native.c from scratch in a temp dir and import-checks the
 dispatch surface (crc32c, bulk key encoding, the redwood block codec).
 Skips cleanly (exit 75, EX_TEMPFAIL) on hosts without a C compiler — the
-pure-Python fallbacks are the supported path there."""
+pure-Python fallbacks are the supported path there.
+
+The --sanitize mode is the runtime half of natlint (docs/natlint.md): it
+rebuilds the extension under ASan/UBSan and re-runs the three parity
+fuzzes (VStore read path, redwood block codec, transport framing) against
+the instrumented build, so memory errors the static rules can't prove are
+still caught in tier-1."""
 
 import os
 import subprocess
@@ -20,3 +26,17 @@ def test_native_extension_compiles_and_imports():
         pytest.skip("no C compiler on PATH")
     assert proc.returncode == 0, proc.stderr
     assert "build_native: OK" in proc.stdout
+
+
+def test_parity_fuzzes_clean_under_sanitizers():
+    proc = subprocess.run(["sh", _SCRIPT, "--sanitize=address,undefined"],
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode == 75:
+        pytest.skip("no C compiler or sanitizer runtime on this host")
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    # every fuzz family must have actually run — a silently-skipped fuzz
+    # would report "clean" while covering nothing
+    for marker in ("vstore parity OK", "redwood codec parity OK",
+                   "transport framing fuzz OK", "no sanitizer reports"):
+        assert marker in out, out

@@ -1,0 +1,37 @@
+"""CHANGES.md row-alignment gate (scripts/changes_check.py): the newest
+`PR <n>:` row must match the `# ISSUE <n>` header — run here so tier-1
+fails a PR that forgot (or placeholder-backfilled) its CHANGES row."""
+
+import importlib.util
+import os
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                       "changes_check.py")
+_spec = importlib.util.spec_from_file_location("changes_check", _SCRIPT)
+changes_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(changes_check)
+
+
+def test_parsers():
+    assert changes_check.issue_number("# ISSUE 16 · [x] title\n") == 16
+    assert changes_check.issue_number("no header") is None
+    text = "PR 1: a\nPR 2: b\nsome prose\nPR 10: c\n"
+    assert changes_check.newest_changes_row(text) == 10
+    assert changes_check.newest_changes_row("prose only") is None
+
+
+def test_misaligned_rows_fail(tmp_path):
+    issue = tmp_path / "ISSUE.md"
+    changes = tmp_path / "CHANGES.md"
+    issue.write_text("# ISSUE 16 · title\n")
+    changes.write_text("PR 15: old row\n")
+    assert changes_check.main([str(issue), str(changes)]) == 1
+    changes.write_text("PR 15: old row\nPR 16: this PR\n")
+    assert changes_check.main([str(issue), str(changes)]) == 0
+    # no ISSUE.md (post-merge checkouts): nothing to align, pass
+    assert changes_check.main([str(tmp_path / "gone.md"),
+                               str(changes)]) == 0
+
+
+def test_live_repo_rows_are_aligned():
+    assert changes_check.main([]) == 0

@@ -1,0 +1,505 @@
+"""natlint (NAT001..NAT007): fixtures both ways per rule, the enforcement
+gate over the real package, and mutation proofs against fdb_native.c.
+
+The mutation tests are the teeth: each takes the REAL extension source,
+re-introduces one historical violation shape (deletes a Py_DECREF from an
+error ladder, drops the GIL window, removes the decoded-count guard...) and
+asserts the rule catches it — while the unmutated file stays clean. A rule
+that passes its toy fixtures but goes blind on 2000 lines of real C fails
+here.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from foundationdb_tpu.analysis import flowlint
+from foundationdb_tpu.analysis.__main__ import main as lint_main
+from foundationdb_tpu.analysis.natlint import analyze_c_source
+
+_C_SRC = os.path.join(os.path.dirname(__file__), "..", "foundationdb_tpu",
+                      "native", "fdb_native.c")
+
+
+def _details(src: str, rule: str | None = None) -> list[str]:
+    return [f.detail for f in analyze_c_source(textwrap.dedent(src))
+            if rule is None or f.rule == rule]
+
+
+def _real_source() -> str:
+    with open(_C_SRC, encoding="utf-8") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# family registration
+# ---------------------------------------------------------------------------
+
+def test_family_registered():
+    assert "nat" in flowlint.FAMILIES
+    assert flowlint.rule_family("NAT001") == "nat"
+    codes = sorted(r.code for r in flowlint.active_rules("nat"))
+    assert codes == [f"NAT00{i}" for i in range(1, 8)]
+    # and the CLI accepts the family
+    assert lint_main(["--family", "nat", "--list-rules"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# NAT001 — unchecked allocation
+# ---------------------------------------------------------------------------
+
+def test_nat001_flags_use_before_null_test():
+    src = """
+    static PyObject *f(PyObject *o) {
+        char *p = malloc(16);
+        p[0] = 1;
+        return NULL;
+    }
+    """
+    assert "unchecked-alloc:p" in _details(src, "NAT001")
+
+
+def test_nat001_accepts_null_test_and_ternary():
+    src = """
+    static PyObject *f(PyObject *o) {
+        char *p = malloc(16);
+        if (!p)
+            return NULL;
+        p[0] = 1;
+        PyObject *v = PyBytes_FromStringAndSize(p, 16);
+        PyObject *pair = v ? PyTuple_Pack(1, v) : NULL;
+        return pair;
+    }
+    """
+    assert _details(src, "NAT001") == []
+
+
+def test_nat001_flags_inline_discarded_allocation():
+    src = """
+    static int f(PyObject *o) {
+        use(malloc(8));
+        return 0;
+    }
+    """
+    assert "discarded-alloc:malloc" in _details(src, "NAT001")
+
+
+# ---------------------------------------------------------------------------
+# NAT002 — refcount balance on error paths
+# ---------------------------------------------------------------------------
+
+def test_nat002_flags_early_return_leaking_owned_ref():
+    src = """
+    static PyObject *f(PyObject *o) {
+        PyObject *a = PyList_New(0);
+        if (!a)
+            return NULL;
+        PyObject *b = PyDict_New();
+        if (!b)
+            return NULL;
+        Py_DECREF(b);
+        return a;
+    }
+    """
+    assert "leak:a@return" in _details(src, "NAT002")
+
+
+def test_nat002_accepts_goto_ladder_that_releases_everything():
+    src = """
+    static PyObject *f(PyObject *o) {
+        PyObject *a = PyList_New(0);
+        if (!a)
+            return NULL;
+        PyObject *b = PyDict_New();
+        if (!b)
+            goto err;
+        Py_DECREF(b);
+        return a;
+    err:
+        Py_XDECREF(a);
+        return NULL;
+    }
+    """
+    assert _details(src, "NAT002") == []
+
+
+def test_nat002_ladder_missing_one_release_is_flagged():
+    src = """
+    static PyObject *f(PyObject *o) {
+        PyObject *a = PyList_New(0);
+        if (!a)
+            return NULL;
+        PyObject *b = PyDict_New();
+        if (!b)
+            goto err;
+        Py_DECREF(b);
+        return a;
+    err:
+        return NULL;
+    }
+    """
+    assert "leak:a@err" in _details(src, "NAT002")
+
+
+def test_nat002_stolen_and_aliased_refs_end_ownership():
+    src = """
+    static PyObject *f(PyObject *o) {
+        PyObject *out = PyList_New(1);
+        if (!out)
+            return NULL;
+        PyObject *v = PyLong_FromLong(1);
+        if (!v)
+            goto err;
+        PyList_SET_ITEM(out, 0, v);
+        return out;
+    err:
+        Py_DECREF(out);
+        return NULL;
+    }
+    """
+    assert _details(src, "NAT002") == []
+
+
+# ---------------------------------------------------------------------------
+# NAT003 — unchecked fallible calls
+# ---------------------------------------------------------------------------
+
+def test_nat003_flags_ignored_error_return():
+    src = """
+    static int f(PyObject *lst, PyObject *item) {
+        PyList_Append(lst, item);
+        return 0;
+    }
+    """
+    assert "ignored-call:PyList_Append" in _details(src, "NAT003")
+
+
+def test_nat003_errocc_requires_pyerr_occurred():
+    bad = """
+    static int f(PyObject *o) {
+        long v = PyLong_AsLong(o);
+        if (v < 0)
+            return 0;
+        return 1;
+    }
+    """
+    good = """
+    static int f(PyObject *o) {
+        long v = PyLong_AsLong(o);
+        if (v == -1 && PyErr_Occurred())
+            return 0;
+        return 1;
+    }
+    """
+    assert any(d.startswith("ambiguous-errcheck:PyLong_AsLong")
+               for d in _details(bad, "NAT003"))
+    assert _details(good, "NAT003") == []
+
+
+def test_nat003_condition_and_void_cast_accepted():
+    src = """
+    static int f(PyObject *lst, PyObject *item) {
+        if (PyList_Append(lst, item) < 0)
+            return -1;
+        (void)PyObject_IsTrue(item);
+        return 0;
+    }
+    """
+    assert _details(src, "NAT003") == []
+
+
+# ---------------------------------------------------------------------------
+# NAT004 — unbounded buffer access
+# ---------------------------------------------------------------------------
+
+def test_nat004_get_item_without_psequence_fast():
+    src = """
+    static PyObject *f(PyObject *args) {
+        PyObject *s = PyTuple_Pack(1, args);
+        if (!s)
+            return NULL;
+        PyObject *x = PySequence_Fast_GET_ITEM(s, 0);
+        Py_DECREF(s);
+        return x;
+    }
+    """
+    assert "unvalidated-fast:s" in _details(src, "NAT004")
+
+
+def test_nat004_fast_discipline_with_size_bound_is_clean():
+    src = """
+    static PyObject *f(PyObject *args) {
+        PyObject *s = PySequence_Fast(args, "need seq");
+        if (!s)
+            return NULL;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(s);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *x = PySequence_Fast_GET_ITEM(s, i);
+            use(x);
+        }
+        Py_DECREF(s);
+        return NULL;
+    }
+    """
+    assert _details(src, "NAT004") == []
+
+
+def test_nat004_buffer_memcpy_needs_len_guard():
+    bad = """
+    static PyObject *f(PyObject *args) {
+        Py_buffer data;
+        if (!PyArg_ParseTuple(args, "y*", &data))
+            return NULL;
+        const uint8_t *b = (const uint8_t *)data.buf;
+        uint32_t v;
+        memcpy(&v, b, 4);
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    """
+    good = bad.replace("uint32_t v;", """uint32_t v;
+        if (data.len < 4) {
+            PyBuffer_Release(&data);
+            return NULL;
+        }""")
+    assert "unguarded-buffer:b" in _details(bad, "NAT004")
+    assert _details(good, "NAT004") == []
+
+
+# ---------------------------------------------------------------------------
+# NAT005 — wire-struct emit parity with schema comments
+# ---------------------------------------------------------------------------
+
+_EMIT = """
+    static int emit(WBuf *w, uint64_t tid) {
+        if (wb_byte(&w, 'R') < 0 || wb_varint(&w, tid) < 0 ||
+            wb_varint(&w, %d) < 0)
+            return -1;
+        return 0;
+    }
+"""
+
+
+def test_nat005_schema_count_drift_and_undocumented_emit():
+    documented = "/* Foo { a, b, c } */\n" + _EMIT
+    assert "schema-count:Foo" in _details(documented % 2, "NAT005")
+    assert _details(documented % 3, "NAT005") == []
+    assert "undocumented-emit" in _details(_EMIT % 3, "NAT005")
+
+
+# ---------------------------------------------------------------------------
+# NAT006 — GIL across pure-C bulk loops
+# ---------------------------------------------------------------------------
+
+_GIL_SRC = """
+    static void bulk_xor(uint8_t *p, size_t len) {
+        for (size_t i = 0; i < len; i++)
+            p[i] ^= 1;
+    }
+    static PyObject *entry(PyObject *self, PyObject *args) {
+        Py_buffer data;
+        if (!PyArg_ParseTuple(args, "y*", &data))
+            return NULL;
+        %s
+        PyBuffer_Release(&data);
+        Py_RETURN_NONE;
+    }
+"""
+
+
+def test_nat006_bulk_loop_without_window_is_flagged():
+    bad = _GIL_SRC % "bulk_xor((uint8_t *)data.buf, (size_t)data.len);"
+    good = _GIL_SRC % ("Py_BEGIN_ALLOW_THREADS\n"
+                       "        bulk_xor((uint8_t *)data.buf, "
+                       "(size_t)data.len);\n"
+                       "        Py_END_ALLOW_THREADS")
+    assert "gil:bulk_xor" in _details(bad, "NAT006")
+    assert _details(good, "NAT006") == []
+
+
+def test_nat006_helper_with_cpython_calls_is_not_bulk():
+    src = """
+    static void helper(uint8_t *p, size_t len) {
+        for (size_t i = 0; i < len; i++)
+            PyMem_Free(p);
+    }
+    static PyObject *entry(PyObject *self, PyObject *args) {
+        helper(NULL, 4);
+        Py_RETURN_NONE;
+    }
+    """
+    assert _details(src, "NAT006") == []
+
+
+# ---------------------------------------------------------------------------
+# NAT007 — decoded counts trusted before validation
+# ---------------------------------------------------------------------------
+
+_DEC_SRC = """
+    static PyObject *dec(PyObject *self, PyObject *args) {
+        Py_buffer data;
+        uint32_t n;
+        if (!PyArg_ParseTuple(args, "y*", &data))
+            return NULL;
+        if (data.len < 4) {
+            PyBuffer_Release(&data);
+            return NULL;
+        }
+        memcpy(&n, data.buf, 4);
+        %s
+        PyObject *out = PyList_New(n);
+        PyBuffer_Release(&data);
+        return out;
+    }
+"""
+
+
+def test_nat007_decoded_count_must_be_validated():
+    bad = _DEC_SRC % ""
+    good = _DEC_SRC % ("if (n > 1024) {\n"
+                       "            PyBuffer_Release(&data);\n"
+                       "            return NULL;\n        }")
+    assert "decoded:n" in _details(bad, "NAT007")
+    assert _details(good, "NAT007") == []
+
+
+# ---------------------------------------------------------------------------
+# inline suppression
+# ---------------------------------------------------------------------------
+
+def test_inline_c_suppression_silences_the_named_rule_only():
+    src = """
+    static PyObject *f(PyObject *o) {
+        char *p = malloc(16);
+        /* natlint: ignore[NAT001] */
+        p[0] = 1;
+        PyList_Append(o, o);
+        return NULL;
+    }
+    """
+    details = _details(src)
+    assert not any(d.startswith("unchecked-alloc") for d in details)
+    assert "ignored-call:PyList_Append" in details  # other rules unaffected
+
+
+# ---------------------------------------------------------------------------
+# mutation proofs on the real fdb_native.c
+# ---------------------------------------------------------------------------
+
+def _mutate(src: str, old: str, new: str) -> str:
+    assert src.count(old) == 1, f"mutation anchor not unique: {old!r}"
+    return src.replace(old, new)
+
+
+def test_mutation_deleting_decref_from_corrupt_ladder_trips_nat002():
+    src = _real_source()
+    mutated = _mutate(
+        src,
+        "    corrupt_list:\n"
+        "        Py_XDECREF(prev_key);\n"
+        "        Py_DECREF(out);\n",
+        "    corrupt_list:\n"
+        "        Py_XDECREF(prev_key);\n")
+    details = [f.detail for f in analyze_c_source(mutated)
+               if f.rule == "NAT002"]
+    assert "leak:out@corrupt_list" in details
+    assert "leak:out@corrupt_list" not in [
+        f.detail for f in analyze_c_source(src)]
+
+
+def test_mutation_deleting_decref_from_early_return_trips_nat002():
+    src = _real_source()
+    mutated = _mutate(
+        src,
+        "            if (rc < 0) {\n"
+        "                Py_DECREF(it);\n"
+        "                return -1;\n"
+        "            }",
+        "            if (rc < 0)\n"
+        "                return -1;")
+    leaks = [f for f in analyze_c_source(mutated)
+             if f.rule == "NAT002" and f.detail == "leak:it@return"
+             and f.symbol == "enc_value"]
+    assert leaks, "deleted Py_DECREF(it) not caught"
+
+
+def test_mutation_removing_gil_window_trips_nat006():
+    src = _real_source()
+    mutated = _mutate(src, "        Py_BEGIN_ALLOW_THREADS\n", "")
+    mutated = _mutate(mutated, "        Py_END_ALLOW_THREADS\n", "")
+    hits = [f for f in analyze_c_source(mutated)
+            if f.rule == "NAT006" and f.symbol == "py_crc32c"]
+    assert any(f.detail == "gil:crc32c_sw" for f in hits)
+    assert not [f for f in analyze_c_source(src)
+                if f.rule == "NAT006" and f.symbol == "py_crc32c"]
+
+
+def test_mutation_removing_count_guard_trips_nat007():
+    src = _real_source()
+    mutated = _mutate(
+        src,
+        "    if (n > plen / 8)\n        goto corrupt;\n", "")
+    hits = [f for f in analyze_c_source(mutated)
+            if f.rule == "NAT007" and f.detail == "decoded:n"
+            and f.symbol == "py_redwood_decode_block"]
+    assert hits, "unvalidated decoded count not caught"
+    assert not [f for f in analyze_c_source(src)
+                if f.rule == "NAT007" and f.detail == "decoded:n"]
+
+
+def test_mutation_removing_pyerr_check_trips_nat003():
+    src = _real_source()
+    mutated = _mutate(
+        src,
+        "        if (tid == (uint64_t)-1 && PyErr_Occurred())\n"
+        "            return -1; /* registry id not an int-like: report, "
+        "don't emit */\n",
+        "")
+    hits = [f.detail for f in analyze_c_source(mutated)
+            if f.rule == "NAT003" and f.symbol == "enc_value"]
+    assert any("PyLong_AsUnsignedLongLong:tid" in d for d in hits)
+    assert not [f for f in analyze_c_source(src)
+                if f.rule == "NAT003" and f.symbol == "enc_value"]
+
+
+def test_mutation_bypassing_fast_conversion_trips_nat004():
+    src = _real_source()
+    mutated = _mutate(src, "PySequence_Fast_GET_ITEM(skipf, t)",
+                      "PySequence_Fast_GET_ITEM(skip, t)")
+    hits = [f for f in analyze_c_source(mutated)
+            if f.rule == "NAT004" and f.detail == "unvalidated-fast:skip"]
+    assert hits, "GET_ITEM on the raw argument not caught"
+    assert not [f for f in analyze_c_source(src)
+                if f.rule == "NAT004"
+                and f.symbol == "py_encode_conflict_ranges"]
+
+
+# ---------------------------------------------------------------------------
+# enforcement: the real package is natlint-clean modulo the baseline
+# ---------------------------------------------------------------------------
+
+def test_package_is_natlint_clean():
+    """The nat family over the default target set reports zero
+    non-baselined violations and zero stale entries — same gate shape as
+    test_package_is_flowlint_clean."""
+    findings = flowlint.analyze_paths(flowlint.default_targets(),
+                                      flowlint.active_rules("nat"))
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    new, stale = flowlint.apply_baseline(findings, baseline,
+                                         families={"nat"})
+    assert new == [], [f.message for f in new]
+    assert stale == []
+
+
+def test_nat_baseline_entries_are_documented_gil_exemptions():
+    """The only grandfathered NAT findings are the two bounded redwood
+    CRC loops, each with a documented reason (the generic FIXME gate lives
+    in test_flowlint.py; this pins the natlint-specific policy: every
+    exemption names why the unbounded-input concern does not apply)."""
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    nat = [e for e in baseline.entries if e["rule"].startswith("NAT")]
+    assert nat, "expected the documented NAT006 redwood exemptions"
+    for entry in nat:
+        assert entry["rule"] == "NAT006"
+        assert "REDWOOD_BLOCK_BYTES" in entry["reason"]
