@@ -655,7 +655,12 @@ def _engine_rows(n_keys: int, value_bytes: int, memtable_bytes: int) -> dict:
     out: dict = {"dataset_bytes": n_keys * (8 + value_bytes),
                  "n_keys": n_keys,
                  "redwood_memtable_bytes": memtable_bytes}
-    for engine in ("memory", "ssd", "redwood"):
+    # redwood_python = the same engine with REDWOOD_NATIVE_READS=0: the
+    # pure-Python lookup path, i.e. the r11 configuration (ablation row)
+    for label in ("memory", "ssd", "redwood", "redwood_python"):
+        engine = "redwood" if label == "redwood_python" else label
+        KNOBS.set("REDWOOD_NATIVE_READS",
+                  0 if label == "redwood_python" else 1)
         base = tempfile.mkdtemp(prefix=f"fdbtpu-bench-{engine}-")
         store = _open_engine(engine, base)
         t0 = time.monotonic()
@@ -682,6 +687,8 @@ def _engine_rows(n_keys: int, value_bytes: int, memtable_bytes: int) -> dict:
         for i in order:
             assert store2.get(keys[i]) is not None
         cold_s = time.monotonic() - t0
+        point_stats = (store2.read_stats()
+                       if hasattr(store2, "read_stats") else None)
         t0 = time.monotonic()
         n = len(store2.get_range(b"", b"\xff" * 8))
         scan_s = time.monotonic() - t0
@@ -694,7 +701,10 @@ def _engine_rows(n_keys: int, value_bytes: int, memtable_bytes: int) -> dict:
                "cold_scan_keys_per_sec": round(n_keys / scan_s, 1)}
         if shape is not None:
             row["level_shape"] = {str(k): v for k, v in shape.items()}
-        out[engine] = row
+        if point_stats is not None:
+            row["cold_point_read_stats"] = point_stats
+        out[label] = row
+    KNOBS.set("REDWOOD_NATIVE_READS", 1)
     return out
 
 
@@ -768,6 +778,25 @@ def run_storage_engines() -> dict:
     }
 
 
+def run_redwood_reads(clients: int = 1000, seconds: float = 5.0) -> dict:
+    """The native-read-path rows for BENCH_r13: the r11-shaped engine-files
+    comparison (now with the redwood_python ablation row = the r11
+    configuration) plus an r10-shaped e2e read row on the redwood engine
+    with the native path on and off."""
+    out: dict = {
+        "engine_files": _engine_rows(n_keys=20_000, value_bytes=128,
+                                     memtable_bytes=256_000),
+    }
+    for label, native_reads in (("e2e_read_native", 1),
+                                ("e2e_read_python", 0)):
+        out[label] = run(
+            clients=clients, seconds=seconds, backend="oracle",
+            n_proxies=0, n_storage=1, phases=("read",),
+            extra_knobs={"STORAGE_ENGINE": "redwood",
+                         "REDWOOD_NATIVE_READS": native_reads})
+    return out
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(json.loads(sys.argv[2]))
@@ -777,6 +806,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--storage-engines" in sys.argv:
         print(json.dumps(run_storage_engines(), indent=2))
+        sys.exit(0)
+    if "--redwood-reads" in sys.argv:
+        print(json.dumps(run_redwood_reads(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

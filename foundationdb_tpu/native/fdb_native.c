@@ -103,17 +103,23 @@ static PyObject *py_crc32c(PyObject *self, PyObject *args) {
  *
  *   RedwoodBlockHeader { magic: u32, n_entries: u32, payload_bytes: u32, crc: u32 }
  *   RedwoodBlockEntry { shared: u16, suffix_len: u16, value_len: u32 }
- *   RedwoodRunHeader { magic: u32, format_version: u32, run_id: u64, meta_seq: u64, level: u32, n_blocks: u32, n_sources: u32, index_bytes: u32, aux_bytes: u32, body_crc: u32 }
+ *   RedwoodRunHeader { magic: u32, format_version: u32, run_id: u64, meta_seq: u64, level: u32, n_blocks: u32, n_sources: u32, index_bytes: u32, aux_bytes: u32, bloom_bytes: u32, body_crc: u32 }
  *   RedwoodRunIndexEntry { offset: u32, length: u32, last_key_len: u16 }
+ *   RedwoodBloomHeader { magic: u32, n_hashes: u32, n_bits: u64, n_keys: u64 }
  *
  * All fields little-endian. The block payload is a sequence of entries,
  * each RedwoodBlockEntry header + key suffix + value, keys prefix-
  * compressed against the previous key in the block; crc is CRC-32C over
- * the payload. Only the block codec lives in C (the hot path: every flush,
- * compaction, and cold read crosses it); run-file assembly stays in Python
- * on both paths, so there is exactly one orchestration to keep correct.
- * The Python fallback (storage/redwood.py py_encode_block/py_decode_block)
- * must produce bit-identical bytes — the parity fuzz is the gate. */
+ * the payload. A run body is sources + index + aux + bloom + blocks; the
+ * bloom section is a RedwoodBloomHeader followed by ceil(n_bits/8) filter
+ * bytes (double hashing over CRC-32C, see rw_bloom_hashes below). The
+ * block codec AND the point-read path live in C (RedwoodRun handles further
+ * down); run-file assembly stays in Python on both paths, so there is
+ * exactly one orchestration to keep correct. The Python fallbacks
+ * (storage/redwood.py py_encode_block/py_decode_block/py_bloom_build/
+ * py_bloom_query) must produce bit-identical bytes and decisions — the
+ * parity fuzzes in tests/test_redwood.py and tests/test_redwood_native.py
+ * are the gate. */
 
 #define REDWOOD_BLOCK_MAGIC 0x5EDB10C5u
 
@@ -2313,6 +2319,911 @@ static PyTypeObject VStoreType = {
     .tp_new = vstore_new,
 };
 
+/* ------------------------------------------------------------------ */
+/* Redwood native read path                                            */
+/* ------------------------------------------------------------------ */
+
+/* A RedwoodRun handle owns one immutable run image (the bytes object is
+ * kept alive for the handle's lifetime, so value reads are zero-copy
+ * extents into it), a parsed run index, the optional bloom section, the
+ * run's range tombstones, and a bounded FIFO block cache with the same
+ * semantics as the Python dict cache in storage/redwood.py (_block):
+ * decode on miss, evict the oldest insertion, never reorder on hit. */
+
+#define REDWOOD_RUN_MAGIC 0x5EDB4513u
+#define REDWOOD_RUN_FORMAT_VERSION 2u
+#define REDWOOD_BLOOM_MAGIC 0x5EDBB1F1u
+#define REDWOOD_BLOOM_SALT 0xB1u
+
+/* Python bytes ordering: lexicographic, shorter string sorts first on tie */
+static int rw_bytes_cmp(const uint8_t *a, Py_ssize_t alen,
+                        const uint8_t *b, Py_ssize_t blen) {
+    Py_ssize_t n = alen < blen ? alen : blen;
+    int c = n ? memcmp(a, b, n) : 0;
+    if (c)
+        return c;
+    return (alen > blen) - (alen < blen);
+}
+
+/* Double hashing over CRC-32C: h1 = crc32c(key), h2 = crc32c(key + salt).
+ * Extending h1 by the salt byte equals hashing the concatenation, so the
+ * Python fallback (crc32c(key + b"\xb1")) lands on the same h2. */
+static void rw_bloom_hashes(const uint8_t *key, Py_ssize_t klen,
+                            uint32_t *h1, uint32_t *h2) {
+    uint8_t salt = REDWOOD_BLOOM_SALT;
+    *h1 = crc32c_sw(0, key, klen);
+    *h2 = crc32c_sw(*h1, &salt, 1);
+}
+
+static int rw_bloom_maybe(const uint8_t *bits, uint64_t n_bits,
+                          uint32_t n_hashes, const uint8_t *key,
+                          Py_ssize_t klen) {
+    uint32_t h1, h2;
+    rw_bloom_hashes(key, klen, &h1, &h2);
+    for (uint32_t i = 0; i < n_hashes; i++) {
+        uint64_t bit = ((uint64_t)h1 + (uint64_t)i * h2) % n_bits;
+        if (!(bits[bit >> 3] & (1u << (bit & 7))))
+            return 0;
+    }
+    return 1;
+}
+
+/* Validate a bloom section (header + filter bytes); -1 without PyErr. */
+static int rw_bloom_parse(const uint8_t *sec, Py_ssize_t seclen,
+                          uint32_t *n_hashes, uint64_t *n_bits) {
+    if (seclen < 24)
+        return -1;
+    uint32_t magic, nh;
+    uint64_t nb;
+    memcpy(&magic, sec, 4);
+    memcpy(&nh, sec + 4, 4);
+    memcpy(&nb, sec + 8, 8);
+    if (magic != REDWOOD_BLOOM_MAGIC || nb == 0 || nh < 1 || nh > 64)
+        return -1;
+    if ((uint64_t)(seclen - 24) != (nb + 7) / 8)
+        return -1;
+    *n_hashes = nh;
+    *n_bits = nb;
+    return 0;
+}
+
+static PyObject *py_redwood_bloom_build(PyObject *self, PyObject *args) {
+    PyObject *keys;
+    long bits_per_key, n_hashes;
+    if (!PyArg_ParseTuple(args, "Oll", &keys, &bits_per_key, &n_hashes))
+        return NULL;
+    if (bits_per_key < 1 || n_hashes < 1 || n_hashes > 64) {
+        PyErr_SetString(PyExc_ValueError, "bad bloom parameters");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(keys, "keys must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    uint64_t n_bits = (uint64_t)n * (uint64_t)bits_per_key;
+    if (n_bits < 64)
+        n_bits = 64;
+    Py_ssize_t nbytes = (Py_ssize_t)((n_bits + 7) / 8);
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 24 + nbytes);
+    if (!out) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    uint8_t *o = (uint8_t *)PyBytes_AS_STRING(out);
+    uint32_t magic = REDWOOD_BLOOM_MAGIC, nh32 = (uint32_t)n_hashes;
+    uint64_t nk = (uint64_t)n;
+    memcpy(o, &magic, 4);
+    memcpy(o + 4, &nh32, 4);
+    memcpy(o + 8, &n_bits, 8);
+    memcpy(o + 16, &nk, 8);
+    uint8_t *bits = o + 24;
+    memset(bits, 0, nbytes);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        char *k;
+        Py_ssize_t klen;
+        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(seq, i),
+                                    &k, &klen) < 0) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return NULL;
+        }
+        uint32_t h1, h2;
+        rw_bloom_hashes((const uint8_t *)k, klen, &h1, &h2);
+        for (uint32_t j = 0; j < nh32; j++) {
+            uint64_t bit = ((uint64_t)h1 + (uint64_t)j * h2) % n_bits;
+            bits[bit >> 3] |= (uint8_t)(1u << (bit & 7));
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+static PyObject *py_redwood_bloom_query(PyObject *self, PyObject *args) {
+    Py_buffer sec, key;
+    if (!PyArg_ParseTuple(args, "y*y*", &sec, &key))
+        return NULL;
+    uint32_t nh;
+    uint64_t nb;
+    if (rw_bloom_parse((const uint8_t *)sec.buf, sec.len, &nh, &nb) < 0) {
+        PyBuffer_Release(&sec);
+        PyBuffer_Release(&key);
+        PyErr_SetString(PyExc_ValueError, "corrupt redwood bloom section");
+        return NULL;
+    }
+    int maybe = rw_bloom_maybe((const uint8_t *)sec.buf + 24, nb, nh,
+                               (const uint8_t *)key.buf, key.len);
+    PyBuffer_Release(&sec);
+    PyBuffer_Release(&key);
+    return PyBool_FromLong(maybe);
+}
+
+/* One decoded block resident in the cache: keys are materialized (prefix
+ * decompression), values stay as extents into the run image. */
+typedef struct {
+    int32_t block;    /* block index resident here, or -1 */
+    uint32_t n;       /* entries */
+    uint8_t *keys;    /* concatenated full keys */
+    size_t *key_off;  /* offsets into keys */
+    uint32_t *key_len;
+    Py_ssize_t *val_off; /* absolute offsets into the run image */
+    uint32_t *val_len;
+} RWCacheSlot;
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *image;  /* owned bytes: the whole run file */
+    PyObject *clears; /* owned PySequence_Fast of (begin, end) tuples */
+    const uint8_t *buf;
+    Py_ssize_t blen;
+    uint32_t n_blocks;
+    Py_ssize_t *blk_off; /* absolute block offsets in the image */
+    uint32_t *blk_len;
+    Py_ssize_t *lk_off; /* per-block last-key extents (into the image) */
+    uint32_t *lk_len;
+    const uint8_t *bloom_bits; /* NULL when the run carries no bloom */
+    uint64_t bloom_nbits;
+    uint32_t bloom_hashes;
+    const uint8_t **cl_bp; /* clear-range begin/end extents (borrowed via */
+    Py_ssize_t *cl_bl;     /* the owned clears sequence above) */
+    const uint8_t **cl_ep;
+    Py_ssize_t *cl_el;
+    Py_ssize_t n_clears;
+    RWCacheSlot *slots; /* FIFO ring: fill, then evict at hand */
+    int32_t *slot_of;   /* n_blocks entries: slot index or -1 */
+    uint32_t cache_cap;
+    uint32_t hand;
+    int closed;
+    uint64_t hits, misses, bloom_neg, blocks_decoded;
+} RedwoodRun;
+
+static PyTypeObject RedwoodRunType;
+
+static void rr_slot_clear(RWCacheSlot *s) {
+    PyMem_Free(s->keys);
+    PyMem_Free(s->key_off);
+    PyMem_Free(s->key_len);
+    PyMem_Free(s->val_off);
+    PyMem_Free(s->val_len);
+    memset(s, 0, sizeof(*s));
+    s->block = -1;
+}
+
+static void rr_drop(RedwoodRun *self) {
+    if (self->slots) {
+        for (uint32_t i = 0; i < self->cache_cap; i++)
+            rr_slot_clear(&self->slots[i]);
+        PyMem_Free(self->slots);
+        self->slots = NULL;
+    }
+    PyMem_Free(self->slot_of);
+    PyMem_Free(self->blk_off);
+    PyMem_Free(self->blk_len);
+    PyMem_Free(self->lk_off);
+    PyMem_Free(self->lk_len);
+    PyMem_Free(self->cl_bp);
+    PyMem_Free(self->cl_bl);
+    PyMem_Free(self->cl_ep);
+    PyMem_Free(self->cl_el);
+    self->slot_of = NULL;
+    self->blk_off = NULL;
+    self->blk_len = NULL;
+    self->lk_off = NULL;
+    self->lk_len = NULL;
+    self->cl_bp = NULL;
+    self->cl_bl = NULL;
+    self->cl_ep = NULL;
+    self->cl_el = NULL;
+    self->n_clears = 0;
+    self->n_blocks = 0;
+    self->bloom_bits = NULL;
+    self->buf = NULL;
+    self->blen = 0;
+    Py_CLEAR(self->image);
+    Py_CLEAR(self->clears);
+    self->closed = 1;
+}
+
+/* Decode block `bi` into slot `s` (same validation order as the block
+ * codec above and the Python fallback). 0 on success, -1 with PyErr. */
+static int rr_decode_into(RedwoodRun *self, uint32_t bi, RWCacheSlot *s) {
+    const uint8_t *b = self->buf + self->blk_off[bi];
+    Py_ssize_t bl = self->blk_len[bi];
+    uint32_t magic, n, plen, crc;
+    if (bl < 16)
+        goto corrupt;
+    memcpy(&magic, b, 4);
+    memcpy(&n, b + 4, 4);
+    memcpy(&plen, b + 8, 4);
+    memcpy(&crc, b + 12, 4);
+    if (magic != REDWOOD_BLOCK_MAGIC || (Py_ssize_t)plen != bl - 16 ||
+        crc32c_sw(0, b + 16, plen) != crc)
+        goto corrupt;
+    /* every entry costs at least its 8-byte header: reject a corrupt count
+     * before it sizes the slot arrays */
+    if (n > plen / 8)
+        goto corrupt;
+    size_t *ko = PyMem_Malloc(((size_t)n + 1) * sizeof(size_t));
+    if (!ko)
+        goto nomem;
+    s->key_off = ko;
+    uint32_t *kl = PyMem_Malloc(((size_t)n + 1) * 4);
+    if (!kl)
+        goto nomem;
+    s->key_len = kl;
+    Py_ssize_t *vo = PyMem_Malloc(((size_t)n + 1) * sizeof(Py_ssize_t));
+    if (!vo)
+        goto nomem;
+    s->val_off = vo;
+    uint32_t *vl = PyMem_Malloc(((size_t)n + 1) * 4);
+    if (!vl)
+        goto nomem;
+    s->val_len = vl;
+    /* prefix re-expansion can exceed the payload size; grow on demand */
+    size_t kcap = (size_t)plen + 16;
+    uint8_t *kb = PyMem_Malloc(kcap);
+    if (!kb)
+        goto nomem;
+    s->keys = kb;
+    {
+        const uint8_t *p = b + 16, *end = b + 16 + plen;
+        size_t koff = 0;
+        size_t prev_off = 0;
+        uint32_t prev_len = 0;
+        int have_prev = 0;
+        for (uint32_t i = 0; i < n; i++) {
+            uint16_t shared, slen;
+            uint32_t vlen;
+            if (end - p < 8)
+                goto corrupt;
+            memcpy(&shared, p, 2);
+            memcpy(&slen, p + 2, 2);
+            memcpy(&vlen, p + 4, 4);
+            p += 8;
+            if ((Py_ssize_t)(end - p) < (Py_ssize_t)slen + (Py_ssize_t)vlen ||
+                (!have_prev && shared != 0) ||
+                (have_prev && shared > prev_len))
+                goto corrupt;
+            size_t klen = (size_t)shared + slen;
+            if (koff + klen > kcap) {
+                size_t ncap = kcap * 2;
+                while (ncap < koff + klen)
+                    ncap *= 2;
+                uint8_t *nk = PyMem_Realloc(s->keys, ncap);
+                if (!nk)
+                    goto nomem;
+                s->keys = nk;
+                kcap = ncap;
+            }
+            if (shared)
+                memmove(s->keys + koff, s->keys + prev_off, shared);
+            memcpy(s->keys + koff + shared, p, slen);
+            p += slen;
+            s->key_off[i] = koff;
+            s->key_len[i] = (uint32_t)klen;
+            s->val_off[i] = p - self->buf;
+            s->val_len[i] = vlen;
+            p += vlen;
+            prev_off = koff;
+            prev_len = (uint32_t)klen;
+            have_prev = 1;
+            koff += klen;
+        }
+        if (p != end)
+            goto corrupt;
+    }
+    s->n = n;
+    s->block = (int32_t)bi;
+    return 0;
+corrupt:
+    PyErr_SetString(PyExc_ValueError, "corrupt redwood block");
+    return -1;
+nomem:
+    PyErr_NoMemory();
+    return -1;
+}
+
+/* Cache lookup for block `bi`: FIFO ring, decode on miss. NULL on error
+ * (slot left empty, PyErr set). */
+static RWCacheSlot *rr_block(RedwoodRun *self, uint32_t bi) {
+    int32_t si = self->slot_of[bi];
+    if (si >= 0) {
+        self->hits++;
+        return &self->slots[si];
+    }
+    self->misses++;
+    self->blocks_decoded++;
+    uint32_t slot = self->hand;
+    RWCacheSlot *s = &self->slots[slot];
+    if (s->block >= 0)
+        self->slot_of[s->block] = -1;
+    rr_slot_clear(s);
+    if (rr_decode_into(self, bi, s) < 0) {
+        rr_slot_clear(s);
+        return NULL;
+    }
+    self->slot_of[bi] = (int32_t)slot;
+    self->hand = (slot + 1) % self->cache_cap;
+    return s;
+}
+
+static int rr_cleared(RedwoodRun *self, const uint8_t *key, Py_ssize_t klen) {
+    for (Py_ssize_t i = 0; i < self->n_clears; i++) {
+        if (rw_bytes_cmp(self->cl_bp[i], self->cl_bl[i], key, klen) <= 0 &&
+            rw_bytes_cmp(key, klen, self->cl_ep[i], self->cl_el[i]) < 0)
+            return 1;
+    }
+    return 0;
+}
+
+/* index of the first block whose last_key >= key (== n_blocks when every
+ * block ends before key) — _Run.first_block_for */
+static int64_t rr_first_block_for(RedwoodRun *self, const uint8_t *key,
+                                  Py_ssize_t klen) {
+    int64_t lo = 0, hi = self->n_blocks;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (rw_bytes_cmp(self->buf + self->lk_off[mid], self->lk_len[mid],
+                         key, klen) < 0)
+            lo = mid + 1;
+        else
+            hi = mid;
+    }
+    return lo;
+}
+
+/* Point lookup within one run. 1 = found (voff/vlen set; an in-run entry
+ * beats the run's own clears, matching the Python read order), 2 = shadowed
+ * by this run's clears, 0 = miss, -1 = error with PyErr set. */
+static int rr_find(RedwoodRun *self, const uint8_t *key, Py_ssize_t klen,
+                   Py_ssize_t *voff, uint32_t *vlen) {
+    if (self->closed) {
+        PyErr_SetString(PyExc_ValueError, "redwood run handle is closed");
+        return -1;
+    }
+    if (self->bloom_bits &&
+        !rw_bloom_maybe(self->bloom_bits, self->bloom_nbits,
+                        self->bloom_hashes, key, klen)) {
+        self->bloom_neg++;
+        return rr_cleared(self, key, klen) ? 2 : 0;
+    }
+    int64_t bi = rr_first_block_for(self, key, klen);
+    if (bi < (int64_t)self->n_blocks) {
+        RWCacheSlot *s = rr_block(self, (uint32_t)bi);
+        if (!s)
+            return -1;
+        int64_t lo = 0, hi = s->n;
+        while (lo < hi) {
+            int64_t mid = (lo + hi) >> 1;
+            if (rw_bytes_cmp(s->keys + s->key_off[mid], s->key_len[mid],
+                             key, klen) < 0)
+                lo = mid + 1;
+            else
+                hi = mid;
+        }
+        if (lo < (int64_t)s->n && s->key_len[lo] == (uint64_t)klen &&
+            memcmp(s->keys + s->key_off[lo], key, klen) == 0) {
+            *voff = s->val_off[lo];
+            *vlen = s->val_len[lo];
+            return 1;
+        }
+    }
+    return rr_cleared(self, key, klen) ? 2 : 0;
+}
+
+static PyObject *rr_get(RedwoodRun *self, PyObject *arg) {
+    char *k;
+    Py_ssize_t klen;
+    if (PyBytes_AsStringAndSize(arg, &k, &klen) < 0)
+        return NULL;
+    Py_ssize_t voff = 0;
+    uint32_t vlen = 0;
+    int st = rr_find(self, (const uint8_t *)k, klen, &voff, &vlen);
+    if (st < 0)
+        return NULL;
+    if (st != 1)
+        return Py_BuildValue("(iO)", st, Py_None);
+    PyObject *val = PyBytes_FromStringAndSize((const char *)self->buf + voff,
+                                              vlen);
+    if (!val)
+        return NULL;
+    return Py_BuildValue("(iN)", 1, val);
+}
+
+static PyObject *rr_may_contain(RedwoodRun *self, PyObject *arg) {
+    char *k;
+    Py_ssize_t klen;
+    if (self->closed) {
+        PyErr_SetString(PyExc_ValueError, "redwood run handle is closed");
+        return NULL;
+    }
+    if (PyBytes_AsStringAndSize(arg, &k, &klen) < 0)
+        return NULL;
+    if (!self->bloom_bits)
+        Py_RETURN_TRUE;
+    return PyBool_FromLong(rw_bloom_maybe(self->bloom_bits, self->bloom_nbits,
+                                          self->bloom_hashes,
+                                          (const uint8_t *)k, klen));
+}
+
+static PyObject *rr_stats(RedwoodRun *self, PyObject *noargs) {
+    return Py_BuildValue(
+        "{s:K,s:K,s:K,s:K,s:I}",
+        "block_cache_hits", (unsigned long long)self->hits,
+        "block_cache_misses", (unsigned long long)self->misses,
+        "bloom_negatives", (unsigned long long)self->bloom_neg,
+        "blocks_decoded", (unsigned long long)self->blocks_decoded,
+        "n_blocks", (unsigned int)self->n_blocks);
+}
+
+static PyObject *rr_close_method(RedwoodRun *self, PyObject *noargs) {
+    rr_drop(self); /* idempotent: everything it frees is NULLed */
+    Py_RETURN_NONE;
+}
+
+static void rr_dealloc(RedwoodRun *self) {
+    rr_drop(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyMethodDef rr_methods[] = {
+    {"get", (PyCFunction)rr_get, METH_O,
+     "get(key) -> (status, value): 1 found, 0 miss, 2 shadowed by this "
+     "run's clear ranges"},
+    {"may_contain", (PyCFunction)rr_may_contain, METH_O,
+     "may_contain(key) -> bloom verdict (True when the run has no bloom)"},
+    {"stats", (PyCFunction)rr_stats, METH_NOARGS,
+     "stats() -> dict of block-cache / bloom counters"},
+    {"close", (PyCFunction)rr_close_method, METH_NOARGS,
+     "close(): release the image and cache (idempotent)"},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject RedwoodRunType = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "fdb_native.RedwoodRun",
+    .tp_basicsize = sizeof(RedwoodRun),
+    .tp_dealloc = (destructor)rr_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "immutable redwood run handle (open via redwood_run_open)",
+    .tp_methods = rr_methods,
+};
+
+/* redwood_run_open(image, clears, cache_blocks) -> RedwoodRun.
+ * `image` is a complete v2 run file (RedwoodRunHeader + body); `clears`
+ * the run's decoded range tombstones as (begin, end) bytes tuples (the
+ * aux region is wire-encoded — Python already decoded it in parse_run, so
+ * the wire codec is not re-implemented here). Raises ValueError on
+ * anything a Python parse_run would reject. */
+static PyObject *py_redwood_run_open(PyObject *self, PyObject *args) {
+    PyObject *image, *clears;
+    long cache_blocks;
+    if (!PyArg_ParseTuple(args, "SOl", &image, &clears, &cache_blocks))
+        return NULL;
+    const uint8_t *buf = (const uint8_t *)PyBytes_AS_STRING(image);
+    Py_ssize_t blen = PyBytes_GET_SIZE(image);
+    uint32_t magic, ver, n_blocks, n_sources, index_bytes, aux_bytes,
+        bloom_bytes, body_crc;
+    if (blen < 52)
+        goto corrupt;
+    memcpy(&magic, buf, 4);
+    memcpy(&ver, buf + 4, 4);
+    memcpy(&n_blocks, buf + 28, 4);
+    memcpy(&n_sources, buf + 32, 4);
+    memcpy(&index_bytes, buf + 36, 4);
+    memcpy(&aux_bytes, buf + 40, 4);
+    memcpy(&bloom_bytes, buf + 44, 4);
+    memcpy(&body_crc, buf + 48, 4);
+    if (magic != REDWOOD_RUN_MAGIC || ver != REDWOOD_RUN_FORMAT_VERSION)
+        goto corrupt;
+    uint64_t fixed = (uint64_t)n_sources * 8 + (uint64_t)index_bytes +
+                     (uint64_t)aux_bytes + (uint64_t)bloom_bytes;
+    if (fixed > (uint64_t)(blen - 52))
+        goto corrupt;
+    /* every index entry costs at least its 10 fixed bytes: reject a corrupt
+     * block count before it sizes the index arrays */
+    if (n_blocks > index_bytes / 10)
+        goto corrupt;
+    {
+        uint32_t crc;
+        Py_BEGIN_ALLOW_THREADS
+        crc = crc32c_sw(0, buf + 52, blen - 52);
+        Py_END_ALLOW_THREADS
+        if (crc != body_crc)
+            goto corrupt;
+    }
+    RedwoodRun *run = (RedwoodRun *)RedwoodRunType.tp_alloc(&RedwoodRunType,
+                                                            0);
+    if (!run)
+        return NULL;
+    Py_INCREF(image);
+    run->image = image;
+    run->buf = buf;
+    run->blen = blen;
+    run->n_blocks = n_blocks;
+    {
+        uint32_t cap = cache_blocks < 1 ? 1 : (uint32_t)cache_blocks;
+        if (n_blocks && cap > n_blocks)
+            cap = n_blocks;
+        run->cache_cap = cap;
+    }
+    Py_ssize_t *po = PyMem_Malloc(((size_t)n_blocks + 1) * sizeof(Py_ssize_t));
+    if (!po)
+        goto nomem;
+    run->blk_off = po;
+    uint32_t *pl = PyMem_Malloc(((size_t)n_blocks + 1) * 4);
+    if (!pl)
+        goto nomem;
+    run->blk_len = pl;
+    Py_ssize_t *lo = PyMem_Malloc(((size_t)n_blocks + 1) * sizeof(Py_ssize_t));
+    if (!lo)
+        goto nomem;
+    run->lk_off = lo;
+    uint32_t *ll = PyMem_Malloc(((size_t)n_blocks + 1) * 4);
+    if (!ll)
+        goto nomem;
+    run->lk_len = ll;
+    int32_t *so = PyMem_Malloc(((size_t)n_blocks + 1) * sizeof(int32_t));
+    if (!so)
+        goto nomem;
+    run->slot_of = so;
+    for (uint32_t i = 0; i < n_blocks; i++)
+        run->slot_of[i] = -1;
+    RWCacheSlot *slots = PyMem_Malloc((size_t)run->cache_cap *
+                                      sizeof(RWCacheSlot));
+    if (!slots)
+        goto nomem;
+    memset(slots, 0, (size_t)run->cache_cap * sizeof(RWCacheSlot));
+    for (uint32_t i = 0; i < run->cache_cap; i++)
+        slots[i].block = -1;
+    run->slots = slots;
+    {
+        const uint8_t *ip = buf + 52 + (size_t)n_sources * 8;
+        const uint8_t *iend = ip + index_bytes;
+        Py_ssize_t blocks_off = 52 + (Py_ssize_t)fixed;
+        Py_ssize_t blocks_len = blen - blocks_off;
+        for (uint32_t i = 0; i < n_blocks; i++) {
+            uint32_t boff, bl32;
+            uint16_t kl16;
+            if (iend - ip < 10)
+                goto corrupt_run;
+            memcpy(&boff, ip, 4);
+            memcpy(&bl32, ip + 4, 4);
+            memcpy(&kl16, ip + 8, 2);
+            ip += 10;
+            if (iend - ip < (Py_ssize_t)kl16)
+                goto corrupt_run;
+            run->lk_off[i] = ip - buf;
+            run->lk_len[i] = kl16;
+            ip += kl16;
+            if ((uint64_t)boff + bl32 > (uint64_t)blocks_len)
+                goto corrupt_run;
+            run->blk_off[i] = blocks_off + (Py_ssize_t)boff;
+            run->blk_len[i] = bl32;
+        }
+        if (ip != iend)
+            goto corrupt_run;
+        if (bloom_bytes) {
+            const uint8_t *bsec = buf + 52 + (size_t)n_sources * 8 +
+                                  index_bytes + aux_bytes;
+            if (rw_bloom_parse(bsec, (Py_ssize_t)bloom_bytes,
+                               &run->bloom_hashes, &run->bloom_nbits) < 0)
+                goto corrupt_run;
+            run->bloom_bits = bsec + 24;
+        }
+    }
+    {
+        PyObject *seq = PySequence_Fast(clears, "clears must be a sequence");
+        if (!seq)
+            goto fail;
+        run->clears = seq; /* the handle owns it from here on */
+        Py_ssize_t ncl = PySequence_Fast_GET_SIZE(seq);
+        const uint8_t **bp = PyMem_Malloc(((size_t)ncl + 1) * sizeof(void *));
+        if (!bp)
+            goto nomem;
+        run->cl_bp = bp;
+        Py_ssize_t *blens = PyMem_Malloc(((size_t)ncl + 1) *
+                                         sizeof(Py_ssize_t));
+        if (!blens)
+            goto nomem;
+        run->cl_bl = blens;
+        const uint8_t **ep = PyMem_Malloc(((size_t)ncl + 1) * sizeof(void *));
+        if (!ep)
+            goto nomem;
+        run->cl_ep = ep;
+        Py_ssize_t *elens = PyMem_Malloc(((size_t)ncl + 1) *
+                                         sizeof(Py_ssize_t));
+        if (!elens)
+            goto nomem;
+        run->cl_el = elens;
+        for (Py_ssize_t i = 0; i < ncl; i++) {
+            /* tuples only: a list pair could be mutated after open, leaving
+             * the cached pointers dangling */
+            PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+            if (!PyTuple_CheckExact(pair) || PyTuple_GET_SIZE(pair) != 2) {
+                PyErr_SetString(PyExc_TypeError,
+                                "clears must be (begin, end) bytes tuples");
+                goto fail;
+            }
+            char *cb, *ce;
+            Py_ssize_t cbl, cel;
+            if (PyBytes_AsStringAndSize(PyTuple_GET_ITEM(pair, 0),
+                                        &cb, &cbl) < 0 ||
+                PyBytes_AsStringAndSize(PyTuple_GET_ITEM(pair, 1),
+                                        &ce, &cel) < 0)
+                goto fail;
+            run->cl_bp[i] = (const uint8_t *)cb;
+            run->cl_bl[i] = cbl;
+            run->cl_ep[i] = (const uint8_t *)ce;
+            run->cl_el[i] = cel;
+            run->n_clears = i + 1;
+        }
+    }
+    return (PyObject *)run;
+corrupt:
+    PyErr_SetString(PyExc_ValueError, "corrupt redwood run");
+    return NULL;
+corrupt_run:
+    Py_DECREF(run);
+    PyErr_SetString(PyExc_ValueError, "corrupt redwood run");
+    return NULL;
+nomem:
+    Py_DECREF(run);
+    return PyErr_NoMemory();
+fail:
+    Py_DECREF(run);
+    return NULL;
+}
+
+/* validate every element is an open RedwoodRun handle */
+static int rw_check_runs(PyObject *seq, Py_ssize_t n) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *o = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyObject_TypeCheck(o, &RedwoodRunType)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "runs must be RedwoodRun handles");
+            return -1;
+        }
+        if (((RedwoodRun *)o)->closed) {
+            PyErr_SetString(PyExc_ValueError,
+                            "redwood run handle is closed");
+            return -1;
+        }
+    }
+    return 0;
+}
+
+/* newest-source-wins cascade over run handles: 1 found (extent returned),
+ * 0 miss or shadowed by a clear, -1 error. `runs` has been validated by
+ * rw_check_runs and `n_runs` is its PySequence_Fast_GET_SIZE bound. */
+static int rw_cascade(PyObject *runs, Py_ssize_t n_runs, const uint8_t *key,
+                      Py_ssize_t klen, RedwoodRun **vrun, Py_ssize_t *voff,
+                      uint32_t *vlen) {
+    for (Py_ssize_t i = 0; i < n_runs; i++) {
+        RedwoodRun *r = (RedwoodRun *)PySequence_Fast_GET_ITEM(runs, i);
+        int st = rr_find(r, key, klen, voff, vlen);
+        if (st < 0)
+            return -1;
+        if (st == 1) {
+            *vrun = r;
+            return 1;
+        }
+        if (st == 2)
+            return 0;
+    }
+    return 0;
+}
+
+/* redwood_runs_get(runs, key) -> value bytes | None */
+static PyObject *py_redwood_runs_get(PyObject *self, PyObject *args) {
+    PyObject *runs, *keyobj;
+    if (!PyArg_ParseTuple(args, "OS", &runs, &keyobj))
+        return NULL;
+    PyObject *seq = PySequence_Fast(runs, "runs must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (rw_check_runs(seq, n) < 0) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    RedwoodRun *vr = NULL;
+    Py_ssize_t voff = 0;
+    uint32_t vlen = 0;
+    int st = rw_cascade(seq, n, (const uint8_t *)PyBytes_AS_STRING(keyobj),
+                        PyBytes_GET_SIZE(keyobj), &vr, &voff, &vlen);
+    Py_DECREF(seq);
+    if (st < 0)
+        return NULL;
+    if (st == 0)
+        Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize((const char *)vr->buf + voff, vlen);
+}
+
+/* redwood_runs_get_batch(runs, keys) -> [value | None, ...] — one Python
+ * boundary crossing for the whole batch */
+static PyObject *py_redwood_runs_get_batch(PyObject *self, PyObject *args) {
+    PyObject *runs, *keys;
+    if (!PyArg_ParseTuple(args, "OO", &runs, &keys))
+        return NULL;
+    PyObject *rseq = PySequence_Fast(runs, "runs must be a sequence");
+    if (!rseq)
+        return NULL;
+    Py_ssize_t nr = PySequence_Fast_GET_SIZE(rseq);
+    if (rw_check_runs(rseq, nr) < 0) {
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    PyObject *kseq = PySequence_Fast(keys, "keys must be a sequence");
+    if (!kseq) {
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    Py_ssize_t nk = PySequence_Fast_GET_SIZE(kseq);
+    PyObject *out = PyList_New(nk);
+    if (!out)
+        goto fail;
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        PyObject *kb = PySequence_Fast_GET_ITEM(kseq, i);
+        if (!PyBytes_Check(kb)) {
+            PyErr_SetString(PyExc_TypeError, "keys must be bytes");
+            goto fail;
+        }
+        RedwoodRun *vr = NULL;
+        Py_ssize_t voff = 0;
+        uint32_t vlen = 0;
+        int st = rw_cascade(rseq, nr, (const uint8_t *)PyBytes_AS_STRING(kb),
+                            PyBytes_GET_SIZE(kb), &vr, &voff, &vlen);
+        if (st < 0)
+            goto fail;
+        PyObject *val;
+        if (st == 0) {
+            val = Py_NewRef(Py_None);
+        } else {
+            val = PyBytes_FromStringAndSize((const char *)vr->buf + voff,
+                                            vlen);
+            if (!val)
+                goto fail;
+        }
+        PyList_SET_ITEM(out, i, val);
+    }
+    Py_DECREF(kseq);
+    Py_DECREF(rseq);
+    return out;
+fail:
+    Py_XDECREF(out);
+    Py_DECREF(kseq);
+    Py_DECREF(rseq);
+    return NULL;
+}
+
+/* redwood_runs_get_many_encode(runs, reads, oldest, tid, prefilled)
+ * -> complete GetValuesReply frame. `reads` are (key, version) pairs;
+ * `prefilled` is a same-length list resolving each read against the
+ * engine's memtables: bytes / None = already resolved, False = unresolved
+ * (cascade through the run handles, copying the value straight from the
+ * run image into the frame — the batched zero-copy read path). */
+static PyObject *py_redwood_runs_get_many_encode(PyObject *self,
+                                                 PyObject *args) {
+    PyObject *runs, *reads, *prefilled;
+    long long oldest;
+    unsigned long long tid;
+    if (!PyArg_ParseTuple(args, "OOLKO", &runs, &reads, &oldest, &tid,
+                          &prefilled))
+        return NULL;
+    PyObject *rseq = PySequence_Fast(runs, "runs must be a sequence");
+    if (!rseq)
+        return NULL;
+    Py_ssize_t nr = PySequence_Fast_GET_SIZE(rseq);
+    if (rw_check_runs(rseq, nr) < 0) {
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    PyObject *dseq = PySequence_Fast(reads, "reads must be a sequence");
+    if (!dseq) {
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    PyObject *pseq = PySequence_Fast(prefilled,
+                                     "prefilled must be a sequence");
+    if (!pseq) {
+        Py_DECREF(dseq);
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(dseq);
+    WBuf w = {NULL, 0, 0};
+    if (PySequence_Fast_GET_SIZE(pseq) != n) {
+        PyErr_SetString(PyExc_ValueError,
+                        "prefilled must match reads in length");
+        goto fail;
+    }
+    if (wb_grow(&w, 64 + n * 24) < 0)
+        goto fail;
+    w.buf[w.len++] = W_MAGIC;
+    w.buf[w.len++] = W_VERSION;
+    /* GetValuesReply { results: [(0, value|None) | (1, errname)] } */
+    if (wb_byte(&w, 'R') < 0 || wb_varint(&w, tid) < 0 ||
+        wb_varint(&w, 1) < 0 || wb_byte(&w, 'l') < 0 ||
+        wb_varint(&w, (uint64_t)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key;
+        int64_t version;
+        if (vs_read_item(PySequence_Fast_GET_ITEM(dseq, i), &key,
+                         &version) < 0)
+            goto fail;
+        if (wb_byte(&w, 't') < 0 || wb_varint(&w, 2) < 0)
+            goto fail;
+        if (version < oldest) {
+            size_t elen = strlen(TOO_OLD_NAME);
+            if (wb_byte(&w, 'i') < 0 || wb_varint(&w, 2) < 0 || /* int 1 */
+                wb_byte(&w, 's') < 0 || wb_varint(&w, elen) < 0 ||
+                wb_raw(&w, TOO_OLD_NAME, elen) < 0)
+                goto fail;
+            continue;
+        }
+        if (wb_byte(&w, 'i') < 0 || wb_varint(&w, 0) < 0) /* int 0 */
+            goto fail;
+        PyObject *pf = PySequence_Fast_GET_ITEM(pseq, i);
+        if (pf == Py_False) {
+            RedwoodRun *vr = NULL;
+            Py_ssize_t voff = 0;
+            uint32_t vlen = 0;
+            int st = rw_cascade(rseq, nr,
+                                (const uint8_t *)PyBytes_AS_STRING(key),
+                                PyBytes_GET_SIZE(key), &vr, &voff, &vlen);
+            if (st < 0)
+                goto fail;
+            if (st == 0) {
+                if (wb_byte(&w, 'N') < 0)
+                    goto fail;
+            } else {
+                if (wb_byte(&w, 'b') < 0 || wb_varint(&w, vlen) < 0 ||
+                    wb_raw(&w, vr->buf + voff, vlen) < 0)
+                    goto fail;
+            }
+        } else if (pf == Py_None || PyBytes_Check(pf)) {
+            if (wb_bytes_val(&w, pf) < 0)
+                goto fail;
+        } else {
+            PyErr_SetString(PyExc_TypeError,
+                            "prefilled entries must be bytes, None, or "
+                            "False");
+            goto fail;
+        }
+    }
+    Py_DECREF(pseq);
+    Py_DECREF(dseq);
+    Py_DECREF(rseq);
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+fail:
+    PyMem_Free(w.buf);
+    Py_DECREF(pseq);
+    Py_DECREF(dseq);
+    Py_DECREF(rseq);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
@@ -2322,6 +3233,21 @@ static PyMethodDef methods[] = {
      "py_encode_block)"},
     {"redwood_decode_block", py_redwood_decode_block, METH_O,
      "redwood_decode_block(bytes) -> [(key, value), ...]"},
+    {"redwood_bloom_build", py_redwood_bloom_build, METH_VARARGS,
+     "redwood_bloom_build(keys, bits_per_key, n_hashes) -> bloom section "
+     "bytes (bit-identical to storage/redwood.py py_bloom_build)"},
+    {"redwood_bloom_query", py_redwood_bloom_query, METH_VARARGS,
+     "redwood_bloom_query(section, key) -> bool (False = definitely absent)"},
+    {"redwood_run_open", py_redwood_run_open, METH_VARARGS,
+     "redwood_run_open(image, clears, cache_blocks) -> RedwoodRun handle"},
+    {"redwood_runs_get", py_redwood_runs_get, METH_VARARGS,
+     "redwood_runs_get(runs_newest_first, key) -> value | None"},
+    {"redwood_runs_get_batch", py_redwood_runs_get_batch, METH_VARARGS,
+     "redwood_runs_get_batch(runs_newest_first, keys) -> [value | None]"},
+    {"redwood_runs_get_many_encode", py_redwood_runs_get_many_encode,
+     METH_VARARGS,
+     "redwood_runs_get_many_encode(runs, reads, oldest, tid, prefilled) -> "
+     "GetValuesReply wire frame"},
     {"encode_conflict_ranges", py_encode_conflict_ranges, METH_VARARGS,
      "encode_conflict_ranges(txns, skip_or_None, rb, re, wb, we, rtxn, "
      "wtxn, key_bytes) -> (n_reads, n_writes)"},
@@ -2340,7 +3266,8 @@ static struct PyModuleDef moduledef = {
 
 PyMODINIT_FUNC PyInit_fdb_native(void) {
     crc32c_init();
-    if (PyType_Ready(&OMapType) < 0 || PyType_Ready(&VStoreType) < 0)
+    if (PyType_Ready(&OMapType) < 0 || PyType_Ready(&VStoreType) < 0 ||
+        PyType_Ready(&RedwoodRunType) < 0)
         return NULL;
     g_zero = PyLong_FromLong(0);
     g_too_old_pair = Py_BuildValue("(is)", 1, TOO_OLD_NAME);
@@ -2364,6 +3291,13 @@ PyMODINIT_FUNC PyInit_fdb_native(void) {
     Py_INCREF(&VStoreType);
     if (PyModule_AddObject(m, "VStore", (PyObject *)&VStoreType) < 0) {
         Py_DECREF(&VStoreType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&RedwoodRunType);
+    if (PyModule_AddObject(m, "RedwoodRun", (PyObject *)&RedwoodRunType)
+            < 0) {
+        Py_DECREF(&RedwoodRunType);
         Py_DECREF(m);
         return NULL;
     }

@@ -187,6 +187,20 @@ class StorageServer:
         self._c_range_reads = self.counters.counter("RangeReads")
         self._c_watches = self.counters.counter("Watches")
         self._c_mutations = self.counters.counter("MutationsApplied")
+        # engine read-path observability (redwood exports read_stats();
+        # other engines simply never move these) — counters carry the
+        # cumulative store tallies via delta-sync at snapshot time
+        self._c_engine = {
+            "block_cache_hits": self.counters.counter("EngineBlockCacheHits"),
+            "block_cache_misses":
+                self.counters.counter("EngineBlockCacheMisses"),
+            "bloom_negatives": self.counters.counter("EngineBloomNegatives"),
+            "native_gets": self.counters.counter("EngineNativeReads"),
+            "fallback_gets": self.counters.counter("EngineFallbackReads"),
+            "blocks_decoded": self.counters.counter("EngineBlocksDecoded"),
+            "batch_gets": self.counters.counter("EngineBatchReads"),
+        }
+        self._engine_stats_seen: dict[str, int] = {}
         process.register(Token.STORAGE_METRICS, self._on_metrics)
         self._counters_task = trace_counters_loop(process, self.counters)
         self._ingest_gate: object | None = None  # set while fetchKeys runs
@@ -222,7 +236,24 @@ class StorageServer:
         if self._maint_task is not None:
             self._maint_task.cancel()
 
+    def _sync_engine_counters(self):
+        """Fold the engine's cumulative read-path tallies into the
+        CounterCollection as deltas (counters are monotone; the engine
+        keeps running totals)."""
+        stats = getattr(self.store, "read_stats", None)
+        if stats is None:
+            return
+        for name, total in stats().items():
+            c = self._c_engine.get(name)
+            if c is None:
+                continue
+            delta = total - self._engine_stats_seen.get(name, 0)
+            if delta > 0:
+                c.increment(delta)
+            self._engine_stats_seen[name] = total
+
     def _on_metrics(self, req, reply):
+        self._sync_engine_counters()
         snap = self.counters.as_dict()
         snap["Version"] = self.version.get()
         snap["DurableVersion"] = self.durable_version
@@ -553,10 +584,13 @@ class StorageServer:
                      self._known_committed)
         if target <= self.durable_version:
             return
+        rounds = []
         while self._pending_durable and self._pending_durable[0][0] <= target:
-            _v, muts = self._pending_durable.popleft()
+            rounds.append(self._pending_durable.popleft())
+        prefetch = self._prefetch_atomic_reads(rounds)
+        for _v, muts in rounds:
             for m in muts:
-                self._apply_durable(m)
+                self._apply_durable(m, prefetch)
         self.durable_version = target
         self.store.set_metadata(_DURABLE_VERSION_KEY, str(target).encode())
         # the engine commit runs OFF the loop (run_blocking; inline under
@@ -591,16 +625,58 @@ class StorageServer:
             self.log_epochs = [ep for ep in self.log_epochs
                                if ep.end is None or ep.end > target]
 
-    def _apply_durable(self, m):
+    def _prefetch_atomic_reads(self, rounds) -> dict[bytes, bytes | None]:
+        """Batch the engine reads the atomic ops in this durability window
+        will do: a first-touch atomic (no earlier mutation in the window
+        wrote or cleared its key) reads the pre-window engine value, so all
+        such keys are fetched in ONE engine call (redwood: one Python->C
+        hop across every run) instead of a per-key get() inside
+        _apply_durable. Later-touch atomics must see in-window state and
+        keep the per-key read. The fetch is wrapped in a Storage.EngineRead
+        span so trace_analyze can break out engine residency."""
+        from foundationdb_tpu.utils.types import ATOMIC_OPS
+        get_batch = getattr(self.store, "get_batch", None)
+        if get_batch is None:
+            return {}
+        touched: set[bytes] = set()
+        cleared: list[tuple[bytes, bytes]] = []
+        keys: list[bytes] = []
+        for _v, muts in rounds:
+            for m in muts:
+                if m.type in ATOMIC_OPS and m.param1 not in touched \
+                        and not any(b <= m.param1 < e for b, e in cleared):
+                    keys.append(m.param1)
+                if m.type == MutationType.CLEAR_RANGE:
+                    cleared.append((m.param1, m.param2))
+                else:
+                    touched.add(m.param1)
+        if not keys:
+            return {}
+        from foundationdb_tpu.utils.trace import g_trace_batch
+        loop = self.process.net.loop
+        ident = f"sv{self.durable_version}"
+        g_trace_batch.span_begin("StorageSpan", ident, "Storage.EngineRead",
+                                 at=loop.now())
+        vals = get_batch(keys)
+        g_trace_batch.span_end("StorageSpan", ident, "Storage.EngineRead",
+                               at=loop.now())
+        return dict(zip(keys, vals))
+
+    def _apply_durable(self, m, prefetch=None):
         from foundationdb_tpu.utils.types import ATOMIC_OPS, apply_atomic_op
         if m.type == MutationType.SET_VALUE:
             self.store.set(m.param1, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
             self.store.clear_range(m.param1, m.param2)
         elif m.type in ATOMIC_OPS:
+            # pop, not get: the prefetched value is the pre-window engine
+            # state and is only valid for the FIRST touch of the key
+            if prefetch is not None and m.param1 in prefetch:
+                existing = prefetch.pop(m.param1)
+            else:
+                existing = self.store.get(m.param1)
             self.store.set(m.param1,
-                           apply_atomic_op(m.type, self.store.get(m.param1),
-                                           m.param2))
+                           apply_atomic_op(m.type, existing, m.param2))
 
     # -- reads --
 

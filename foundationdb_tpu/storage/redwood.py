@@ -71,7 +71,8 @@ _OP_META = 2
 
 BLOCK_MAGIC = 0x5EDB10C5
 RUN_MAGIC = 0x5EDB4513
-RUN_FORMAT_VERSION = 1
+RUN_FORMAT_VERSION = 2  # v2: per-run bloom section between aux and blocks
+BLOOM_MAGIC = 0x5EDBB1F1
 
 # RedwoodBlockHeader { magic: u32, n_entries: u32, payload_bytes: u32, crc: u32 }
 _BLOCK_HEADER = struct.Struct("<IIII")
@@ -79,10 +80,13 @@ _BLOCK_HEADER = struct.Struct("<IIII")
 _BLOCK_ENTRY = struct.Struct("<HHI")
 # RedwoodRunHeader { magic: u32, format_version: u32, run_id: u64,
 #                    meta_seq: u64, level: u32, n_blocks: u32, n_sources: u32,
-#                    index_bytes: u32, aux_bytes: u32, body_crc: u32 }
-_RUN_HEADER = struct.Struct("<IIQQIIIIII")
+#                    index_bytes: u32, aux_bytes: u32, bloom_bytes: u32,
+#                    body_crc: u32 }
+_RUN_HEADER = struct.Struct("<IIQQIIIIIII")
 # RedwoodRunIndexEntry { offset: u32, length: u32, last_key_len: u16 }
 _RUN_INDEX = struct.Struct("<IIH")
+# RedwoodBloomHeader { magic: u32, n_hashes: u32, n_bits: u64, n_keys: u64 }
+_BLOOM_HEADER = struct.Struct("<IIQQ")
 
 # field lists the C-schema parity test (tests/test_redwood.py) cross-checks
 # against the comments in fdb_native.c — this side is the binding authority
@@ -90,8 +94,9 @@ BLOCK_HEADER_FIELDS = ["magic", "n_entries", "payload_bytes", "crc"]
 BLOCK_ENTRY_FIELDS = ["shared", "suffix_len", "value_len"]
 RUN_HEADER_FIELDS = ["magic", "format_version", "run_id", "meta_seq",
                      "level", "n_blocks", "n_sources", "index_bytes",
-                     "aux_bytes", "body_crc"]
+                     "aux_bytes", "bloom_bytes", "body_crc"]
 RUN_INDEX_FIELDS = ["offset", "length", "last_key_len"]
+BLOOM_HEADER_FIELDS = ["magic", "n_hashes", "n_bits", "n_keys"]
 
 _CRC32C_TABLE: list[int] | None = None
 
@@ -189,6 +194,70 @@ def decode_block(data: bytes) -> list[tuple[bytes, bytes]]:
 
 
 # ---------------------------------------------------------------------------
+# per-run bloom filters — bit-parity with native/fdb_native.c
+# redwood_bloom_build / redwood_bloom_query
+# ---------------------------------------------------------------------------
+
+# Double hashing over CRC-32C: bit_i = (h1 + i*h2) % n_bits with
+# h1 = crc32c(key) and h2 = crc32c(key + salt). The C side streams the salt
+# byte into h1's CRC state, which equals hashing the concatenation.
+_BLOOM_SALT = b"\xb1"
+
+
+def _bloom_hashes(key: bytes) -> tuple[int, int]:
+    return crc32c(key), crc32c(key + _BLOOM_SALT)
+
+
+def py_bloom_build(keys: list[bytes], bits_per_key: int,
+                   n_hashes: int) -> bytes:
+    """Pure-Python bloom builder; MUST stay byte-identical to the C
+    redwood_bloom_build (tests/test_redwood_native.py parity fuzz is the
+    gate). An empty key list still yields a 64-bit all-zero filter so every
+    query answers False — a bloom can shadow nothing it doesn't hold."""
+    if bits_per_key < 1 or not 1 <= n_hashes <= 64:
+        raise ValueError("bad bloom parameters")
+    n_bits = max(64, len(keys) * bits_per_key)
+    bits = bytearray((n_bits + 7) // 8)
+    for k in keys:
+        h1, h2 = _bloom_hashes(k)
+        for i in range(n_hashes):
+            bit = (h1 + i * h2) % n_bits
+            bits[bit >> 3] |= 1 << (bit & 7)
+    return _BLOOM_HEADER.pack(BLOOM_MAGIC, n_hashes, n_bits,
+                              len(keys)) + bytes(bits)
+
+
+def py_bloom_query(section: bytes, key: bytes) -> bool:
+    if len(section) < _BLOOM_HEADER.size:
+        raise ValueError("corrupt redwood bloom section")
+    magic, n_hashes, n_bits, _n_keys = _BLOOM_HEADER.unpack_from(section, 0)
+    if (magic != BLOOM_MAGIC or n_bits == 0 or not 1 <= n_hashes <= 64
+            or len(section) - _BLOOM_HEADER.size != (n_bits + 7) // 8):
+        raise ValueError("corrupt redwood bloom section")
+    bits = memoryview(section)[_BLOOM_HEADER.size:]
+    h1, h2 = _bloom_hashes(key)
+    for i in range(n_hashes):
+        bit = (h1 + i * h2) % n_bits
+        if not (bits[bit >> 3] >> (bit & 7)) & 1:
+            return False
+    return True
+
+
+def bloom_build(keys: list[bytes], bits_per_key: int, n_hashes: int) -> bytes:
+    from foundationdb_tpu import native
+    if native.available() and hasattr(native.mod, "redwood_bloom_build"):
+        return native.mod.redwood_bloom_build(keys, bits_per_key, n_hashes)
+    return py_bloom_build(keys, bits_per_key, n_hashes)
+
+
+def bloom_query(section: bytes, key: bytes) -> bool:
+    from foundationdb_tpu import native
+    if native.available() and hasattr(native.mod, "redwood_bloom_query"):
+        return native.mod.redwood_bloom_query(section, key)
+    return py_bloom_query(section, key)
+
+
+# ---------------------------------------------------------------------------
 # run container (Python-assembled; blocks inside come from the codec above)
 # ---------------------------------------------------------------------------
 
@@ -196,8 +265,12 @@ def build_run_image(entries: list[tuple[bytes, bytes]],
                     clears: list[tuple[bytes, bytes]],
                     meta: dict[str, bytes],
                     run_id: int, meta_seq: int, level: int,
-                    sources: tuple[int, ...], block_bytes: int) -> bytes:
-    """Assemble one immutable run file image (pure — safe off-loop)."""
+                    sources: tuple[int, ...], block_bytes: int,
+                    bloom_bits_per_key: int | None = None,
+                    bloom_hashes: int | None = None) -> bytes:
+    """Assemble one immutable run file image (pure — safe off-loop).
+    Bloom parameters default to the REDWOOD_BLOOM_* knobs; bits_per_key 0
+    writes no bloom section at all (bloom_bytes == 0)."""
     blocks: list[bytes] = []
     index_parts: list[bytes] = []
     cur: list[tuple[bytes, bytes]] = []
@@ -228,10 +301,14 @@ def build_run_image(entries: list[tuple[bytes, bytes]],
                       sorted(meta.items())))
     src = struct.pack(f"<{len(sources)}Q", *sources) if sources else b""
     index = b"".join(index_parts)
-    body = src + index + aux + b"".join(blocks)
+    bpk = (KNOBS.REDWOOD_BLOOM_BITS_PER_KEY if bloom_bits_per_key is None
+           else bloom_bits_per_key)
+    nh = KNOBS.REDWOOD_BLOOM_HASHES if bloom_hashes is None else bloom_hashes
+    bloom = bloom_build([k for k, _ in entries], bpk, nh) if bpk > 0 else b""
+    body = src + index + aux + bloom + b"".join(blocks)
     header = _RUN_HEADER.pack(RUN_MAGIC, RUN_FORMAT_VERSION, run_id, meta_seq,
                               level, len(blocks), len(sources), len(index),
-                              len(aux), crc32c(body))
+                              len(aux), len(bloom), crc32c(body))
     return header + body
 
 
@@ -251,6 +328,8 @@ class _Run:
     file: object
     name: str
     raw: bytes | None = None  # full image kept only when file lacks pread
+    bloom: bytes = b""        # bloom section (b"" when the run has none)
+    native: object | None = None  # C RedwoodRun handle (None = Python path)
 
     def read_block_bytes(self, i: int) -> bytes:
         off, length, _lk = self.index[i]
@@ -272,14 +351,33 @@ class _Run:
         return lo
 
 
+def _native_run_handle(raw: bytes, clears: list[tuple[bytes, bytes]]):
+    """C RedwoodRun handle for a validated image, or None (knob off, native
+    unavailable, or the C open rejects it — degrade to the Python path, but
+    never drop a run parse_run already accepted)."""
+    if not KNOBS.REDWOOD_NATIVE_READS:
+        return None
+    from foundationdb_tpu import native
+    if not (native.available() and hasattr(native.mod, "redwood_run_open")):
+        return None
+    try:
+        return native.mod.redwood_run_open(
+            bytes(raw), clears, KNOBS.REDWOOD_BLOCK_CACHE_BLOCKS)
+    except (ValueError, TypeError, MemoryError):
+        return None
+
+
 def parse_run(raw: bytes, file, name: str) -> _Run | None:
     """Validate + decode a run file; None for anything torn or foreign
-    (a crashed apply leaves a partial file — recovery must shrug it off)."""
+    (a crashed apply leaves a partial file — recovery must shrug it off).
+    `file=None` marks a short-lived reader (compaction input): no native
+    handle is opened for those."""
     try:
         if len(raw) < _RUN_HEADER.size:
             return None
         (magic, ver, run_id, meta_seq, level, n_blocks, n_sources,
-         index_bytes, aux_bytes, body_crc) = _RUN_HEADER.unpack_from(raw, 0)
+         index_bytes, aux_bytes, bloom_bytes,
+         body_crc) = _RUN_HEADER.unpack_from(raw, 0)
         if magic != RUN_MAGIC or ver != RUN_FORMAT_VERSION:
             return None
         body = raw[_RUN_HEADER.size:]
@@ -301,12 +399,17 @@ def parse_run(raw: bytes, file, name: str) -> _Run | None:
         aux = wire.loads(bytes(body[off:off + aux_bytes]))
         clears = [(b, e) for b, e in aux[0]]
         meta = {k: v for k, v in aux[1]}
-        blocks_off = _RUN_HEADER.size + off + aux_bytes
+        bloom = bytes(body[off + aux_bytes:off + aux_bytes + bloom_bytes])
+        if len(bloom) != bloom_bytes:
+            return None
+        blocks_off = _RUN_HEADER.size + off + aux_bytes + bloom_bytes
         keep_raw = raw if not hasattr(file, "read_range") else None
+        native_handle = (_native_run_handle(raw, clears)
+                         if file is not None else None)
         return _Run(run_id=run_id, meta_seq=meta_seq, level=level,
                     sources=tuple(sources), index=index, clears=clears,
                     meta=meta, blocks_off=blocks_off, file=file, name=name,
-                    raw=keep_raw)
+                    raw=keep_raw, bloom=bloom, native=native_handle)
     except (struct.error, wire.WireError, ValueError, TypeError):
         return None
 
@@ -375,6 +478,13 @@ class RedwoodKeyValueStore:
         self._wal_bytes = 0  # pushed since the last flush (meta churn bound)
         self._plan_active = False
         self._block_cache: dict[tuple[int, int], list] = {}
+        # read-path observability; native per-handle counters are merged in
+        # by read_stats() and folded here when a handle is retired
+        self._read_stats: dict[str, int] = {
+            "block_cache_hits": 0, "block_cache_misses": 0,
+            "bloom_negatives": 0, "blocks_decoded": 0,
+            "native_gets": 0, "fallback_gets": 0, "batch_gets": 0,
+        }
 
     # -- mutation (same surface + WAL batching as the memory engine) --
 
@@ -418,22 +528,30 @@ class RedwoodKeyValueStore:
             for run in self._levels[level]:
                 yield run
 
-    def get(self, key: bytes) -> bytes | None:
+    def _mem_lookup(self, key: bytes) -> tuple[bool, bytes | None]:
+        """Resolve against the memtable + frozen memtable only:
+        (resolved, value). Unresolved keys fall through to the runs."""
         if key in self._mem:
-            return self._mem[key]
+            return True, self._mem[key]
         if _covered(key, self._mem_clears):
-            return None
+            return True, None
         imm = self._imm
         if imm is not None:
             if key in imm.entries:
-                return imm.entries[key]
+                return True, imm.entries[key]
             if _covered(key, imm.clears):
-                return None
+                return True, None
+        return False, None
+
+    def get(self, key: bytes) -> bytes | None:
+        resolved, val = self._mem_lookup(key)
+        if resolved:
+            return val
         for run in self._runs_newest_first():
-            found, val = self._run_get(run, key)
+            found, val, shadowed = self._run_lookup(run, key)
             if found:
                 return val
-            if _covered(key, run.clears):
+            if shadowed:
                 return None
         return None
 
@@ -441,6 +559,8 @@ class RedwoodKeyValueStore:
         ck = (run.run_id, i)
         blk = self._block_cache.get(ck)
         if blk is None:
+            self._read_stats["block_cache_misses"] += 1
+            self._read_stats["blocks_decoded"] += 1
             blk = decode_block(run.read_block_bytes(i))
             cap = KNOBS.REDWOOD_BLOCK_CACHE_BLOCKS
             if len(self._block_cache) >= cap:
@@ -448,9 +568,32 @@ class RedwoodKeyValueStore:
                 # FIFO approximation of LRU, deterministic under sim
                 self._block_cache.pop(next(iter(self._block_cache)))
             self._block_cache[ck] = blk
+        else:
+            self._read_stats["block_cache_hits"] += 1
         return blk
 
+    def _run_lookup(self, run: _Run,
+                    key: bytes) -> tuple[bool, bytes | None, bool]:
+        """(found, value, shadowed-by-this-run's-clears): one run consulted
+        through the native handle when it has one, else the Python path.
+        Decision parity between the two is fuzz-gated
+        (tests/test_redwood_native.py)."""
+        h = run.native
+        if h is not None:
+            self._read_stats["native_gets"] += 1
+            status, val = h.get(key)
+            return status == 1, val, status == 2
+        self._read_stats["fallback_gets"] += 1
+        found, val = self._run_get(run, key)
+        if found:
+            return True, val, False
+        return False, None, _covered(key, run.clears)
+
     def _run_get(self, run: _Run, key: bytes) -> tuple[bool, bytes | None]:
+        """Pure-Python in-run point lookup (the native fallback path)."""
+        if run.bloom and not bloom_query(run.bloom, key):
+            self._read_stats["bloom_negatives"] += 1
+            return False, None
         i = run.first_block_for(key)
         if i >= len(run.index):
             return False, None
@@ -465,6 +608,97 @@ class RedwoodKeyValueStore:
         if lo < len(blk) and blk[lo][0] == key:
             return True, blk[lo][1]
         return False, None
+
+    # -- batched reads (native fast path) --
+
+    def _native_handles(self) -> list | None:
+        """Newest-first C run handles, or None unless EVERY run has one —
+        a mixed cascade would evaluate shadowing out of order."""
+        if not KNOBS.REDWOOD_NATIVE_READS:
+            return None
+        hs = []
+        for run in self._runs_newest_first():
+            if run.native is None:
+                return None
+            hs.append(run.native)
+        return hs
+
+    def get_batch(self, keys: list[bytes]) -> list[bytes | None]:
+        """Point-read a batch: memtable/imm resolved in Python, then ONE
+        C call cascades every remaining key through all run handles.
+        Falls back to per-key get() when any run lacks a handle."""
+        hs = self._native_handles()
+        if hs is None:
+            return [self.get(k) for k in keys]
+        self._read_stats["batch_gets"] += 1
+        out: list[bytes | None] = [None] * len(keys)
+        pending_idx: list[int] = []
+        pending_keys: list[bytes] = []
+        for i, k in enumerate(keys):
+            resolved, val = self._mem_lookup(k)
+            if resolved:
+                out[i] = val
+            else:
+                pending_idx.append(i)
+                pending_keys.append(k)
+        if pending_keys and hs:
+            from foundationdb_tpu import native
+            vals = native.mod.redwood_runs_get_batch(hs, pending_keys)
+            for i, v in zip(pending_idx, vals):
+                out[i] = v
+            self._read_stats["native_gets"] += len(pending_keys)
+        return out
+
+    def get_batch_encoded(self, reads: list[tuple[bytes, int]], oldest: int,
+                          tid: int) -> bytes | None:
+        """Complete GetValuesReply wire frame for (key, version) pairs,
+        serialized in one C call — values copied straight out of the mapped
+        run images, never materialized as Python objects. Returns None when
+        the native fast path is unavailable (caller encodes in Python)."""
+        hs = self._native_handles()
+        if hs is None:
+            return None
+        from foundationdb_tpu import native
+        if not hasattr(native.mod, "redwood_runs_get_many_encode"):
+            return None
+        # memtable/imm resolution stays in Python; False = "cascade the
+        # runs in C" (too-old reads are decided by version in C first)
+        prefilled: list = []
+        for k, _v in reads:
+            resolved, val = self._mem_lookup(k)
+            prefilled.append(val if resolved else False)
+        self._read_stats["batch_gets"] += 1
+        self._read_stats["native_gets"] += len(reads)
+        return native.mod.redwood_runs_get_many_encode(
+            hs, reads, oldest, tid, prefilled)
+
+    def read_stats(self) -> dict[str, int]:
+        """Cumulative read-path counters: store-level tallies merged with
+        every live native handle's per-handle counters (retired handles are
+        folded into the store tallies at close)."""
+        out = dict(self._read_stats)
+        for run in self._runs_newest_first():
+            if run.native is not None:
+                s = run.native.stats()
+                out["block_cache_hits"] += s["block_cache_hits"]
+                out["block_cache_misses"] += s["block_cache_misses"]
+                out["bloom_negatives"] += s["bloom_negatives"]
+                out["blocks_decoded"] += s["blocks_decoded"]
+        return out
+
+    def _retire_run(self, run: _Run) -> None:
+        """Fold a native handle's counters into the store tallies and
+        release its image before the run is dropped."""
+        h = run.native
+        if h is None:
+            return
+        s = h.stats()
+        self._read_stats["block_cache_hits"] += s["block_cache_hits"]
+        self._read_stats["block_cache_misses"] += s["block_cache_misses"]
+        self._read_stats["bloom_negatives"] += s["bloom_negatives"]
+        self._read_stats["blocks_decoded"] += s["blocks_decoded"]
+        h.close()
+        run.native = None
 
     def _run_range(self, run: _Run, begin: bytes, end: bytes):
         i = run.first_block_for(begin)
@@ -655,6 +889,7 @@ class RedwoodKeyValueStore:
                         if r.run_id not in drop or r is run]
                 for r in self._levels[level]:
                     if r.run_id in drop and r is not run:
+                        self._retire_run(r)
                         r.file.truncate()
                 self._levels[level] = kept
                 if not kept:
@@ -704,6 +939,7 @@ class RedwoodKeyValueStore:
         superseded = {s for r in runs for s in r.sources}
         for r in runs:
             if r.run_id in superseded:
+                self._retire_run(r)
                 r.file.truncate()
         runs = [r for r in runs if r.run_id not in superseded]
         for r in sorted(runs, key=lambda r: r.run_id, reverse=True):

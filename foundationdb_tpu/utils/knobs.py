@@ -236,6 +236,11 @@ KNOBS.init("REDWOOD_BLOCK_BYTES", 16_384, (512,))  # sorted-block target size
 KNOBS.init("REDWOOD_COMPACTION_FAN_IN", 4, (2,))  # runs per level -> merge
 KNOBS.init("REDWOOD_BLOCK_CACHE_BLOCKS", 1_024, (2,))  # decoded-block cache
 KNOBS.init("REDWOOD_MAINT_INTERVAL", 0.25)  # storage-server poll period
+# native read path (fdb_native.c RedwoodRun): 0 forces the pure-Python
+# lookup even when the extension is importable — the parity-fuzz lever
+KNOBS.init("REDWOOD_NATIVE_READS", 1, (0,))
+KNOBS.init("REDWOOD_BLOOM_BITS_PER_KEY", 10, (0,))  # 0 -> no bloom section
+KNOBS.init("REDWOOD_BLOOM_HASHES", 6)  # double-hashing probe count
 KNOBS.init("DD_INTERVAL_SECONDS", 2.0)  # shard tracker poll period
 # a storage worker silent for this long is treated as permanently failed and
 # its shards are re-replicated onto a replacement (storageServerFailureTracker

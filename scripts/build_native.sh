@@ -102,10 +102,14 @@ spec = importlib.util.spec_from_file_location("fdb_native", sys.argv[1])
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)
 for sym in ("crc32c", "encode_keys_into", "redwood_encode_block",
-            "redwood_decode_block"):
+            "redwood_decode_block", "redwood_bloom_build",
+            "redwood_bloom_query", "redwood_run_open", "redwood_runs_get",
+            "redwood_runs_get_batch", "redwood_runs_get_many_encode"):
     assert hasattr(m, sym), f"missing symbol {sym}"
 img = m.redwood_encode_block([(b"a", b"1"), (b"ab", b"2")])
 assert m.redwood_decode_block(img) == [(b"a", b"1"), (b"ab", b"2")]
+sec = m.redwood_bloom_build([b"a", b"ab"], 10, 6)
+assert m.redwood_bloom_query(sec, b"a") is True  # never a false negative
 assert m.crc32c(b"123456789") == 0xE3069283  # CRC-32C check value
 print("build_native: OK")
 EOF

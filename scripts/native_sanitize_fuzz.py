@@ -69,6 +69,26 @@ def main() -> int:
     TW.test_container_bound()
     print("sanitize_fuzz: transport framing fuzz OK")
 
+    # 4. Redwood read path: run-handle open/get over randomized (and
+    #    corrupted/truncated) runs, bloom build/query, the multi-run
+    #    cascade, full store lifecycles through torn-tail kills, and the
+    #    batched zero-copy GetValuesReply encoder — the C surfaces that
+    #    walk raw run bytes with computed offsets, i.e. exactly where an
+    #    out-of-bounds read would live.
+    from tests import test_redwood_native as TN
+    if not TN.HAVE_NATIVE:
+        print("sanitize_fuzz: build lacks redwood read path",
+              file=sys.stderr)
+        return 1
+    for seed in (21, 22):
+        TN.fuzz_bloom_parity(seed)
+        TN.fuzz_run_handle_parity(seed)
+        TN.fuzz_run_open_rejects_corrupt(seed)
+        TN.fuzz_runs_cascade_parity(seed)
+    TN.fuzz_store_lifecycle_parity(seed=23)
+    TN.fuzz_batched_encode_parity(seed=24)
+    print("sanitize_fuzz: redwood read path fuzz OK")
+
     # Leak check now, then skip interpreter finalization: CPython teardown
     # frees in an order that would re-trigger interceptors for no extra
     # coverage. gc.collect() first so dead reference cycles created by the

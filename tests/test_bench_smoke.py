@@ -78,6 +78,25 @@ def test_grv_split_slice():
     assert "grv_ms_p50" in decoded["mixed"]
 
 
+def test_redwood_read_slice():
+    """Tier-1 smoke for the redwood native read path end-to-end: a short
+    write+read slice on a cluster whose storage engine is redwood with a
+    memtable small enough that the preload flushes real runs (so recovery
+    and serving open C run handles where the extension is available; the
+    pure-Python fallback serves the same slice elsewhere). Guards boot,
+    WAL/flush/compaction under the bench driver, and the read phase over a
+    flushed engine — not performance."""
+    report = bench_e2e.run(
+        clients=20, seconds=0.5, backend="oracle", n_proxies=0,
+        n_storage=1, n_client_procs=1, phases=("write", "read"),
+        extra_knobs={"STORAGE_ENGINE": "redwood",
+                     "REDWOOD_MEMTABLE_BYTES": 16384})
+    decoded = json.loads(json.dumps(report))
+    assert decoded["write"]["ops_per_sec"] > 0
+    assert decoded["read"]["ops_per_sec"] > 0
+    assert "grv_ms_p50" in decoded["read"]
+
+
 def test_sharded_backend_slice(monkeypatch):
     """Tier-1 smoke for the SHARDED conflict backend: a short commit burst
     through a real process cluster whose resolver runs the 2-wide SPMD mesh

@@ -38,5 +38,6 @@ def test_parity_fuzzes_clean_under_sanitizers():
     # every fuzz family must have actually run — a silently-skipped fuzz
     # would report "clean" while covering nothing
     for marker in ("vstore parity OK", "redwood codec parity OK",
-                   "transport framing fuzz OK", "no sanitizer reports"):
+                   "transport framing fuzz OK", "redwood read path fuzz OK",
+                   "no sanitizer reports"):
         assert marker in out, out

@@ -425,9 +425,17 @@ def test_mutation_deleting_decref_from_early_return_trips_nat002():
 
 
 def test_mutation_removing_gil_window_trips_nat006():
+    # the bare BEGIN/END lines also appear in redwood_run_open since PR 17,
+    # so the anchor carries the py_crc32c call line to stay unique
     src = _real_source()
-    mutated = _mutate(src, "        Py_BEGIN_ALLOW_THREADS\n", "")
-    mutated = _mutate(mutated, "        Py_END_ALLOW_THREADS\n", "")
+    mutated = _mutate(
+        src,
+        "        Py_BEGIN_ALLOW_THREADS\n"
+        "        crc = crc32c_sw(init, (const uint8_t *)data.buf,"
+        " data.len);\n"
+        "        Py_END_ALLOW_THREADS\n",
+        "        crc = crc32c_sw(init, (const uint8_t *)data.buf,"
+        " data.len);\n")
     hits = [f for f in analyze_c_source(mutated)
             if f.rule == "NAT006" and f.symbol == "py_crc32c"]
     assert any(f.detail == "gil:crc32c_sw" for f in hits)
@@ -437,9 +445,13 @@ def test_mutation_removing_gil_window_trips_nat006():
 
 def test_mutation_removing_count_guard_trips_nat007():
     src = _real_source()
+    # the run-handle block parser carries the same guard since PR 17; the
+    # anchor keeps the decode-side comment tail to stay unique
     mutated = _mutate(
         src,
-        "    if (n > plen / 8)\n        goto corrupt;\n", "")
+        " * before it sizes the output list */\n"
+        "    if (n > plen / 8)\n        goto corrupt;\n",
+        " * before it sizes the output list */\n")
     hits = [f for f in analyze_c_source(mutated)
             if f.rule == "NAT007" and f.detail == "decoded:n"
             and f.symbol == "py_redwood_decode_block"]

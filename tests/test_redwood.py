@@ -281,6 +281,7 @@ _EXPECTED_SCHEMAS = {
     "RedwoodBlockEntry": R.BLOCK_ENTRY_FIELDS,
     "RedwoodRunHeader": R.RUN_HEADER_FIELDS,
     "RedwoodRunIndexEntry": R.RUN_INDEX_FIELDS,
+    "RedwoodBloomHeader": R.BLOOM_HEADER_FIELDS,
 }
 
 
@@ -315,5 +316,6 @@ def test_struct_sizes_are_pinned():
     existing store. Pin them."""
     assert R._BLOCK_HEADER.size == 16
     assert R._BLOCK_ENTRY.size == 8
-    assert R._RUN_HEADER.size == 48
+    assert R._RUN_HEADER.size == 52  # v2: + bloom_bytes
     assert R._RUN_INDEX.size == 10
+    assert R._BLOOM_HEADER.size == 24
