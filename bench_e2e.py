@@ -466,7 +466,8 @@ def _stage_breakdown(trace_dir: str) -> dict | None:
             "stages": rep["stages"],
             "queueing_ratio": rep["queueing_ratio"],
             "readback_overlap_ratio": rep["readback_overlap_ratio"],
-            "contention": rep["contention"]}
+            "contention": rep["contention"],
+            "transport": rep["transport"]}
 
 
 def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
@@ -797,6 +798,29 @@ def run_redwood_reads(clients: int = 1000, seconds: float = 5.0) -> dict:
     return out
 
 
+def run_native_transport(clients: int = 1000, seconds: float = 5.0) -> dict:
+    """The native-transport-plane rows for BENCH_r14: the r10-shaped e2e
+    read row on the merged single-storage topology (whole keyspace on one
+    C-backed store, single non-split proxy — both fast-path planes
+    eligible) with the C data plane on, plus the ablation row with it
+    off. trace=True so the stage breakdown carries the cluster-wide
+    transport counter rollup (native_hit_rate is the acceptance signal:
+    the native rows must show the reads actually took the C path)."""
+    out: dict = {}
+    for label, on in (("e2e_read_native", "1"), ("e2e_read_python", "0")):
+        # env var (not just the knob): server processes AND client workers
+        # inherit os.environ, and the env override wins on both sides
+        os.environ["NET_NATIVE_TRANSPORT"] = on
+        try:
+            out[label] = run(
+                clients=clients, seconds=seconds, backend="oracle",
+                n_proxies=0, n_storage=1, phases=("read",), trace=True,
+                extra_knobs={"NET_NATIVE_TRANSPORT": int(on)})
+        finally:
+            os.environ.pop("NET_NATIVE_TRANSPORT", None)
+    return out
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(json.loads(sys.argv[2]))
@@ -809,6 +833,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--redwood-reads" in sys.argv:
         print(json.dumps(run_redwood_reads(), indent=2))
+        sys.exit(0)
+    if "--native-transport" in sys.argv:
+        print(json.dumps(run_native_transport(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

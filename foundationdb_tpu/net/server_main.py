@@ -138,6 +138,15 @@ def main(spec_json: str):
             sampler.trace_report(who=spec["listen"])
         if trace_file is not None:
             from foundationdb_tpu.utils.trace import g_trace_batch, set_sink
+            # final counter dump: a short run may never reach the periodic
+            # 5s tick, and the rollup wants end-of-run totals either way
+            tc = getattr(net, "transport_counters", None)
+            extra = ({"Transport" + k: v for k, v in tc().items()}
+                     if tc is not None else None)
+            for role in roles:
+                coll = getattr(role, "counters", None)
+                if hasattr(coll, "trace"):
+                    coll.trace(loop.now(), extra=extra)
             g_trace_batch.dump()  # buffered span records survive shutdown
             set_sink(None)
             trace_file.close()

@@ -17,8 +17,14 @@ deployment path.
 Wire format (serialize.h's length-prefixed BinaryWriter framing; bodies are
 utils/wire.py typed frames — decode builds only registry-whitelisted types,
 so a hostile peer can corrupt its own requests but never execute code here):
-  u32 length | u64 token | u64 reply_id | u8 kind | crc32 u32 | body
+  u32 length | u64 token | u64 reply_id | u8 kind | crc32c u32 | body
 kind: 0 = request, 1 = reply, 2 = reply-error, 3 = one-way.
+
+With NET_NATIVE_TRANSPORT=1 and a compiled extension, incoming server-side
+connections are served by the C data plane (net/native_transport.py +
+native/fdb_native.c): framing, CRC-32C, and the read-dominant fast-path
+tokens run in C, and only slow-path frames surface here as Python objects.
+See docs/native_transport.md for the token table and fallback contract.
 """
 
 from __future__ import annotations
@@ -26,8 +32,8 @@ from __future__ import annotations
 import asyncio
 import struct
 import time
-import zlib
 
+from foundationdb_tpu.net import native_transport
 from foundationdb_tpu.utils import wire
 
 from foundationdb_tpu.core.eventloop import EventLoop, TaskPriority
@@ -35,10 +41,37 @@ from foundationdb_tpu.core.future import Future, Promise
 from foundationdb_tpu.utils.errors import FDBError
 
 _HEADER = struct.Struct(">IQQBI")
-PROTOCOL_VERSION = 1
+# v2: frame checksum moved zlib.crc32 -> CRC-32C (the native plane computes
+# Castagnoli in C; both sides must agree or every frame rejects)
+PROTOCOL_VERSION = 2
 _CONNECT = b"fdbtpu" + bytes([PROTOCOL_VERSION])
+# hard bound on a single frame body; frames over this drop the connection
+# before the allocation, on both the Python and C paths
+_MAX_FRAME_BYTES = native_transport.MAX_FRAME_BYTES
 
 _REQUEST, _REPLY, _REPLY_ERROR, _ONE_WAY = 0, 1, 2, 3
+
+
+class _ResidueReader:
+    """StreamReader shim that replays bytes the native plane had buffered
+    when it faulted, then delegates to the real reader — the per-connection
+    fallback hands the Python serve loop a mid-stream connection without
+    losing the partial frame."""
+
+    def __init__(self, residue: bytes, reader: asyncio.StreamReader):
+        self._buf = residue
+        self._reader = reader
+
+    async def readexactly(self, n: int) -> bytes:
+        if self._buf:
+            if len(self._buf) >= n:
+                out, self._buf = self._buf[:n], self._buf[n:]
+                return out
+            need = n - len(self._buf)
+            out = self._buf + await self._reader.readexactly(need)
+            self._buf = b""
+            return out
+        return await self._reader.readexactly(n)
 
 
 def _decode_wire_error(payload) -> FDBError:
@@ -223,6 +256,19 @@ class NetTransport:
         # NEW connections, so these must be dropped explicitly or their
         # _on_connection read loops outlive the transport
         self._incoming: set[asyncio.StreamWriter] = set()
+        # transport counters (Python paths; the native plane keeps its own
+        # and transport_counters() sums both)
+        self._c_frames_in = 0
+        self._c_frames_out = 0
+        self._c_bytes_in = 0
+        self._c_bytes_out = 0
+        self._c_checksum_rejects = 0
+        self._c_slow_falls = 0
+        # the native data plane: one TransportTable per transport, shared by
+        # every incoming connection's TransportConn. None = pure Python.
+        self.native_table = None
+        if native_transport.enabled() and native_transport.available():
+            self.native_table = native_transport.new_table()
 
     def _spawn(self, coro) -> asyncio.Task:
         t = self.loop.aio.create_task(coro)
@@ -274,8 +320,9 @@ class NetTransport:
     # -- outgoing --
 
     def _frame(self, token: int, reply_id: int, kind: int, body: bytes) -> bytes:
-        crc = zlib.crc32(body)
-        return _HEADER.pack(len(body), token, reply_id, kind, crc) + body
+        self._c_frames_out += 1
+        self._c_bytes_out += _HEADER.size + len(body)
+        return native_transport.frame(token, reply_id, kind, body)
 
     async def _peer(self, address: str) -> asyncio.StreamWriter:
         fut = self._peers.get(address)
@@ -461,20 +508,35 @@ class NetTransport:
 
     # -- incoming --
 
-    async def _read_frame(self, reader: asyncio.StreamReader):
+    async def _read_raw_frame(self, reader):
+        """Header + body, bounds-checked and counted — but NOT verified:
+        callers that can prove the frame is dead (a reply whose request
+        already expired) skip the checksum instead of burning event-loop
+        time on bytes nobody will read."""
         header = await reader.readexactly(_HEADER.size)
         length, token, reply_id, kind, crc = _HEADER.unpack(header)
+        if length > _MAX_FRAME_BYTES:
+            raise ConnectionError("oversized frame")
         body = await reader.readexactly(length)
-        if zlib.crc32(body) != crc:
+        self._c_frames_in += 1
+        self._c_bytes_in += _HEADER.size + length
+        return token, reply_id, kind, crc, body
+
+    def _verify_and_load(self, crc: int, body: bytes):
+        if native_transport.crc32c(body) != crc:
+            self._c_checksum_rejects += 1
             raise ConnectionError("packet checksum mismatch")
         try:
-            payload = wire.loads(body)
+            return wire.loads(body)
         except wire.WireError as e:
             # undecodable frame: the stream is garbage or hostile — drop the
             # connection (peers reconnect; in-flight requests get
             # broken_promise from the reply-reader's cleanup)
             raise ConnectionError(f"bad wire frame: {e}") from e
-        return token, reply_id, kind, payload
+
+    async def _read_frame(self, reader):
+        token, reply_id, kind, crc, body = await self._read_raw_frame(reader)
+        return token, reply_id, kind, self._verify_and_load(crc, body)
 
     def _peer_ok(self, writer) -> bool:
         """Apply the TLS verify_peers clauses to the session's peer cert
@@ -494,20 +556,94 @@ class NetTransport:
             if connect != _CONNECT:
                 writer.close()  # protocol mismatch (ConnectPacket check :206)
                 return
-            while True:
-                token, reply_id, kind, payload = await self._read_frame(reader)
-                try:
-                    self._dispatch(token, reply_id, kind, payload, writer)
-                except Exception:  # noqa: BLE001 — a bad handler/payload
-                    # must not kill the connection's read loop (every later
-                    # packet from this peer would silently hang otherwise)
-                    if kind == _REQUEST:
-                        writer.write(self._frame(0, reply_id, _REPLY_ERROR,
-                                                 wire.dumps("unknown_error")))
+            if self.native_table is not None:
+                residue = await self._native_serve(reader, writer)
+                if residue is None:
+                    return
+                # native plane fault on THIS connection: degrade to the
+                # Python loop, replaying whatever the plane had buffered
+                reader = _ResidueReader(residue, reader)
+            await self._python_serve(reader, writer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return
         finally:
             self._incoming.discard(writer)
+            # the serve loop only exits on EOF or a protocol reject — in
+            # both cases the drop decision must reach the TCP layer, or a
+            # rejected peer hangs on recv() instead of seeing the close
+            writer.close()
+
+    async def _python_serve(self, reader, writer):
+        """The pure-Python serve loop — the pre-native path, and the
+        fallback target when the native plane degrades a connection."""
+        while True:
+            token, reply_id, kind, payload = await self._read_frame(reader)
+            self._c_slow_falls += 1
+            try:
+                self._dispatch(token, reply_id, kind, payload, writer)
+            except Exception:  # noqa: BLE001 — a bad handler/payload
+                # must not kill the connection's read loop (every later
+                # packet from this peer would silently hang otherwise)
+                if kind == _REQUEST:
+                    writer.write(self._frame(0, reply_id, _REPLY_ERROR,
+                                             wire.dumps("unknown_error")))
+
+    async def _native_serve(self, reader, writer):
+        """Serve this connection through the C data plane. Returns None
+        when the connection is done (EOF; protocol rejects raise), or the
+        plane's buffered residue when it faulted and the Python loop must
+        take over mid-stream (the per-connection fallback contract)."""
+        conn = native_transport.new_conn(self.native_table)
+        while True:
+            chunk = await reader.read(262144)
+            if not chunk:
+                return None  # clean EOF
+            try:
+                replies, slow, err = conn.feed(chunk)
+            except Exception:  # noqa: BLE001 — any native-plane fault
+                # (alloc failure, internal invariant trip) downgrades just
+                # this connection; correctness comes from the Python loop
+                try:
+                    residue = conn.residue()
+                except Exception:  # noqa: BLE001
+                    residue = b""
+                return residue
+            if replies is not None:
+                writer.write(replies)
+            for token, reply_id, kind, body in slow:
+                try:
+                    payload = wire.loads(body)
+                except wire.WireError as e:
+                    raise ConnectionError(f"bad wire frame: {e}") from e
+                try:
+                    self._dispatch(token, reply_id, kind, payload, writer)
+                except Exception:  # noqa: BLE001 — parity with the
+                    # Python loop: a raising handler answers, not hangs
+                    if kind == _REQUEST:
+                        writer.write(self._frame(
+                            0, reply_id, _REPLY_ERROR,
+                            wire.dumps("unknown_error")))
+            if err is not None:
+                # protocol reject (checksum mismatch / oversized frame):
+                # same decision as the Python loop — drop the connection.
+                # Replies queued earlier in this chunk were already written.
+                raise ConnectionError(err)
+
+    def transport_counters(self) -> dict:
+        """Cumulative transport counters: Python paths + native plane."""
+        c = {
+            "FramesIn": self._c_frames_in,
+            "FramesOut": self._c_frames_out,
+            "BytesIn": self._c_bytes_in,
+            "BytesOut": self._c_bytes_out,
+            "ChecksumRejects": self._c_checksum_rejects,
+            "NativeFastPathHits": 0,
+            "PySlowPathFalls": self._c_slow_falls,
+        }
+        if self.native_table is not None:
+            for k, v in self.native_table.counters().items():
+                c[k] = c.get(k, 0) + v
+        return c
 
     def _dispatch(self, token, reply_id, kind, payload, writer):
         handler = self.process.handlers.get(token)
@@ -551,14 +687,28 @@ class NetTransport:
     async def _read_replies(self, reader: asyncio.StreamReader, address: str):
         try:
             while True:
-                _token, reply_id, kind, payload = await self._read_frame(reader)
+                _token, reply_id, kind, crc, body = \
+                    await self._read_raw_frame(reader)
                 entry = self._pending.pop(reply_id, None)
                 if entry is None:
+                    # retransmit-dedup hit: the request already completed or
+                    # expired, so nobody will read this body — skip the
+                    # checksum + decode instead of recomputing CRC-32C on
+                    # the event loop for a frame that gets dropped anyway
                     continue
                 if entry[2] is not None:
                     entry[2].cancel()  # drop the RPC-timeout timer now
                 if entry[0].is_set():
                     continue
+                try:
+                    payload = self._verify_and_load(crc, body)
+                except ConnectionError:
+                    # the entry was already popped: fail it here, then let
+                    # the outer handler fail the rest + drop the peer
+                    if not entry[0].is_set():
+                        entry[0].send_error(
+                            FDBError("broken_promise", "peer closed"))
+                    raise
                 if kind == _REPLY:
                     entry[0].send(payload)
                 elif kind == _REPLY_ERROR:

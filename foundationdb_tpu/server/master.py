@@ -65,9 +65,10 @@ class Master:
             self._lease_task.cancel()
 
     def _on_metrics(self, req, reply):
+        from foundationdb_tpu.utils.stats import fold_transport_counters
         snap = self.counters.as_dict()
         snap["LastVersionAssigned"] = self.last_version_assigned
-        reply.send(snap)
+        reply.send(fold_transport_counters(self.process, snap))
 
     def _on_ping(self, req, reply):
         """Proxy liveness lease: a proxy that cannot reach ITS (undeposed)

@@ -294,6 +294,24 @@ class Proxy:
             self._rk_tasks = [
                 process.spawn(self._rk_fetch_loop(), "getRate"),
                 process.spawn(self._grv_pump(), "transactionStarter")]
+        # native GRV fast path (NET_NATIVE_TRANSPORT): a single-proxy
+        # topology needs no getLiveCommittedVersion peer round, so GRVs can
+        # be answered entirely inside the C transport plane from a pushed
+        # (version, allowance) pair. Multi-proxy and grv_only topologies
+        # must confirm with peers and always fall through to Python. The
+        # native path skips grv_bands and the sim validation oracle — both
+        # are inert on the real event loop where the plane runs.
+        self._native_grv = False
+        self._native_grv_hits = 0
+        native_table = getattr(process.net, "native_table", None)
+        if (native_table is not None and not grv_only
+                and not self.other_proxies
+                and getattr(process.net, "_native_grv_owner", None) is None):
+            from foundationdb_tpu.net import native_transport
+            native_table.enable_grv(*native_transport.grv_wire_ids())
+            process.net._native_grv_owner = self
+            self._native_grv = True
+            self._native_grv_refresh()
         # periodic telemetry dump (the reference's traceCounters cadence):
         # bands are useless if never emitted
         self._bands_task = process.spawn(self._trace_bands_loop(),
@@ -310,6 +328,11 @@ class Proxy:
             self._empty_task.cancel()
         for t in self._rk_tasks:
             t.cancel()
+        if self._native_grv:
+            self._native_grv = False
+            self.process.net.native_table.disable_grv()
+            if getattr(self.process.net, "_native_grv_owner", None) is self:
+                self.process.net._native_grv_owner = None
         self._master_last_seen = float("-inf")  # fence immediately
         queued, self._grv_queue = self._grv_queue, deque()
         for reply in queued:  # don't strand throttled waiters until timeout
@@ -320,10 +343,11 @@ class Proxy:
         reply.send(self.epoch)
 
     def _on_metrics(self, req, reply):
+        from foundationdb_tpu.utils.stats import fold_transport_counters
         snap = self.counters.as_dict()
         snap["CommittedVersion"] = self.committed_version.get()
         snap["GRVQueueDepth"] = len(self._grv_queue)
-        reply.send(snap)
+        reply.send(fold_transport_counters(self.process, snap))
 
     def _shards_from_txn_state(self) -> ShardMap:
         """Derive the routing map (keyInfo) from \\xff/keyServers in the
@@ -435,6 +459,33 @@ class Proxy:
                     and self._inflight_batches < self._window()):
                 self._flush()
 
+    def _native_grv_refresh(self):
+        """Push (committed version, handout allowance) to the C GRV plane.
+
+        Called at every committed-version advance, pump tick, and lease
+        ping, so the plane never holds a version more than one tick stale
+        and stops cold (allowance 0) the moment the master lease dies or
+        ratekeeper-gated requests start queueing. GRVs the plane served
+        since the last refresh are folded into GRVIn and spent from the
+        same token bucket the Python path draws from."""
+        if not self._native_grv:
+            return
+        table = self.process.net.native_table
+        hits = table.counters()["NativeGRVHits"]
+        delta = hits - self._native_grv_hits
+        self._native_grv_hits = hits
+        if delta:
+            self._c_grv_in.increment(delta)
+            if self._rk_tps is not None:
+                self._grv_tokens = max(0.0, self._grv_tokens - delta)
+        if not self._master_live() or self._grv_queue:
+            allowance = 0
+        elif self._rk_tps is None:
+            allowance = 1_000_000  # ungated: refreshed every lease ping
+        else:
+            allowance = max(0, int(self._grv_tokens))
+        table.set_grv(self.committed_version.get(), allowance)
+
     # -- admission control --
 
     async def _rk_fetch_loop(self):
@@ -499,6 +550,7 @@ class Proxy:
                 burst = max(1.0, self._rk_tps * 0.2)
                 self._grv_tokens = min(self._grv_tokens
                                        + self._rk_tps * interval, burst)
+            self._native_grv_refresh()
             while self._grv_queue and self._grv_tokens >= 1.0:
                 self._grv_tokens -= 1.0
                 reply = self._grv_queue.popleft()
@@ -534,6 +586,7 @@ class Proxy:
             except FDBError as e:
                 if e.name == "operation_cancelled":
                     raise
+            self._native_grv_refresh()
             await self.loop.delay(KNOBS.PROXY_MASTER_LEASE_SECONDS / 4)
 
     # -- GRV service --
@@ -1052,6 +1105,7 @@ class Proxy:
             self._infra_failures = 0
             if commit_version > self.committed_version.get():
                 self.committed_version.set(commit_version)
+                self._native_grv_refresh()
             acked_any = False
             for rep, status in zip(replies, statuses):
                 if status == COMMITTED:

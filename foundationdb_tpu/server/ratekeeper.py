@@ -80,7 +80,9 @@ class Ratekeeper:
         self._counters_task.cancel()
 
     def _on_metrics(self, req, reply):
-        reply.send(self.counters.as_dict())
+        from foundationdb_tpu.utils.stats import fold_transport_counters
+        reply.send(fold_transport_counters(self.process,
+                                           self.counters.as_dict()))
 
     def _on_get_rate(self, req, reply):
         n = max(1, req if isinstance(req, int) else 1)  # proxies share the budget

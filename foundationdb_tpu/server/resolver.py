@@ -183,7 +183,8 @@ class Resolver:
         snap["HotRangeBuckets"] = len(self.hot_sketch)
         snap["HotRangeTotalRate"] = round(
             self.hot_sketch.total_rate(self.process.net.loop.now()), 3)
-        reply.send(snap)
+        from foundationdb_tpu.utils.stats import fold_transport_counters
+        reply.send(fold_transport_counters(self.process, snap))
 
     def _on_hot_ranges(self, req, reply):
         """Conflict-hotspot snapshot (ratekeeper + DD poll): hottest K

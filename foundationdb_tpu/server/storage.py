@@ -220,6 +220,15 @@ class StorageServer:
         # mutate the shared WAL queue (apply_maintenance pops/truncates it)
         # while a commit thread is pushing to it.
         self._commit_inflight = False
+        # native transport fast path (net/native_transport.py): while this
+        # server serves everything (no shard map, no revocations) out of
+        # the C versioned map, the transport's C data plane answers
+        # GET_VALUE/GET_VALUES/GET_RANGE straight from the VStore. The
+        # moment sharding starts the plane is disabled for good — per-key
+        # ownership decisions stay in Python.
+        self._native_plane = False
+        self._native_plane_blocked = False
+        self._native_plane_update()
         self._maint_task = None
         if self.engine == "redwood":
             # flush/compaction actor (the reference's Redwood drives these
@@ -231,10 +240,48 @@ class StorageServer:
 
     def shutdown(self):
         """Displaced by a re-created storage role on the same worker."""
+        self._native_plane_blocked = True
+        self._native_plane_update()
         self._pull_task.cancel()
         self._counters_task.cancel()
         if self._maint_task is not None:
             self._maint_task.cancel()
+
+    def _native_plane_update(self):
+        """Enable/refresh/disable this server's claim on the transport's C
+        fast path. Called in the SAME synchronous block as every state
+        change that affects read correctness (version advance,
+        forget_before, rollback, shard layout) — the event loop is single-
+        threaded, so the C plane can never serve between the state change
+        and the bounds push."""
+        table = getattr(self.process.net, "native_table", None)
+        if table is None:
+            return
+        store = getattr(self.data, "_store", None)  # the C VStore, if native
+        eligible = (store is not None and self.shard_ranges is None
+                    and not self._revoked and not self._native_plane_blocked)
+        if not eligible:
+            if self._native_plane:
+                self._native_plane = False
+                if getattr(self.process.net, "_native_storage_owner",
+                           None) is self:
+                    self.process.net._native_storage_owner = None
+                table.disable_storage()
+            return
+        owner = getattr(self.process.net, "_native_storage_owner", None)
+        if owner is not None and owner is not self:
+            return  # another storage role on this transport owns the plane
+        if not self._native_plane:
+            from foundationdb_tpu.net import native_transport
+            table.enable_storage(
+                store, *native_transport.storage_wire_ids(),
+                self.data.oldest_version, self.version.get(),
+                KNOBS.DESIRED_TOTAL_BYTES)
+            self.process.net._native_storage_owner = self
+            self._native_plane = True
+        else:
+            table.set_read_bounds(self.data.oldest_version,
+                                  self.version.get())
 
     def _sync_engine_counters(self):
         """Fold the engine's cumulative read-path tallies into the
@@ -253,12 +300,13 @@ class StorageServer:
             self._engine_stats_seen[name] = total
 
     def _on_metrics(self, req, reply):
+        from foundationdb_tpu.utils.stats import fold_transport_counters
         self._sync_engine_counters()
         snap = self.counters.as_dict()
         snap["Version"] = self.version.get()
         snap["DurableVersion"] = self.durable_version
         snap["LagVersions"] = self.version.get() - self.durable_version
-        reply.send(snap)
+        reply.send(fold_transport_counters(self.process, snap))
 
     # -- recovery (rollback :2211 + log-system rebind) --
 
@@ -295,6 +343,7 @@ class StorageServer:
             return
         rollback_to = req.rollback_to
         self.data.rollback(rollback_to)
+        self._native_plane_update()
         while self._pending_durable and self._pending_durable[-1][0] > rollback_to:
             self._pending_durable.pop()
         # rewind the pull cursor so the new epoch's re-delivered mutations in
@@ -359,9 +408,14 @@ class StorageServer:
                 if (av is None or v > av)
                 and any((e is None or sb < e) and (se is None or b < se)
                         for sb, se in self.shard_ranges)]
+        self._native_plane_update()  # sharded now: the C plane stands down
         reply.send(None)
 
     def _on_add_shard(self, req: AddShardRequest, reply):
+        # a shard is being moved onto this server: from here on, ownership
+        # is per-range and the C fast path must not answer anything
+        self._native_plane_blocked = True
+        self._native_plane_update()
         self.process.spawn(self._add_shard(req, reply), "fetchKeys")
 
     async def _add_shard(self, req: AddShardRequest, reply):
@@ -544,6 +598,7 @@ class StorageServer:
                     self.version.set(end_v)
                     self.data.latest_version = max(self.data.latest_version, end_v)
                     self._trigger_watches(end_v)
+            self._native_plane_update()
             await self._advance_durability()
 
     async def _redwood_maintenance_loop(self):
@@ -607,6 +662,7 @@ class StorageServer:
         finally:
             self._commit_inflight = False
         self.data.forget_before(target)
+        self._native_plane_update()  # oldest bound moved: push before serving
         popped: set[tuple[str, str]] = set()
         for epoch in self.log_epochs:
             for i, addr in enumerate(epoch.addrs):

@@ -80,7 +80,9 @@ class TLog:
         return snap
 
     def _on_metrics(self, req, reply):
-        reply.send(self._metrics_snapshot())
+        from foundationdb_tpu.utils.stats import fold_transport_counters
+        reply.send(fold_transport_counters(self.process,
+                                           self._metrics_snapshot()))
 
     def _on_queue_stats(self, req, reply):
         """TLogQueuingMetrics for the ratekeeper: total un-popped bytes
@@ -349,7 +351,8 @@ class TLogHost:
                     agg[k] = max(agg.get(k, 0), v)
                 else:
                     agg[k] = agg.get(k, 0) + v
-        reply.send(agg)
+        from foundationdb_tpu.utils.stats import fold_transport_counters
+        reply.send(fold_transport_counters(self.process, agg))
 
     def add(self, uid: str, recovery_version: int = 0) -> TLog:
         """uids are unique per recovery ATTEMPT (LogSystemConfig's TLog UIDs),

@@ -259,6 +259,45 @@ def contention_stats(events) -> dict:
     }
 
 
+_TRANSPORT_KEYS = ("TransportFramesIn", "TransportFramesOut",
+                   "TransportBytesIn", "TransportBytesOut",
+                   "TransportChecksumRejects",
+                   "TransportNativeFastPathHits",
+                   "TransportPySlowPathFalls")
+
+
+def transport_stats(events) -> dict:
+    """Cluster-wide wire-plane tallies from the periodic counter dumps.
+    Transport counters are process-wide — every role co-hosted on one
+    process repeats the same tallies under its own Metrics event, and the
+    event ID is the process address — so take the running max per ID
+    (dedupes co-hosted roles AND restarts) and sum across IDs.
+    native_hit_rate = C fast-path serves / frames in."""
+    per_id: dict[str, dict[str, int]] = {}
+    for ev in events:
+        if "TransportFramesIn" not in ev:
+            continue
+        d = per_id.setdefault(str(ev.get("ID")),
+                              dict.fromkeys(_TRANSPORT_KEYS, 0))
+        for k in _TRANSPORT_KEYS:
+            v = ev.get(k)
+            if isinstance(v, (int, float)):
+                d[k] = max(d[k], v)
+    tot = {k: sum(d[k] for d in per_id.values()) for k in _TRANSPORT_KEYS}
+    frames = tot["TransportFramesIn"]
+    return {
+        "frames_in": frames,
+        "frames_out": tot["TransportFramesOut"],
+        "bytes_in": tot["TransportBytesIn"],
+        "bytes_out": tot["TransportBytesOut"],
+        "checksum_rejects": tot["TransportChecksumRejects"],
+        "native_fast_path_hits": tot["TransportNativeFastPathHits"],
+        "py_slow_path_falls": tot["TransportPySlowPathFalls"],
+        "native_hit_rate": (round(tot["TransportNativeFastPathHits"]
+                                  / frames, 4) if frames else 0.0),
+    }
+
+
 def analyze(events) -> dict:
     spans, unmatched = pair_spans(events)
     flows = transaction_timelines(events)
@@ -272,6 +311,7 @@ def analyze(events) -> dict:
         "queueing_ratio": queueing_ratio(stages),
         "readback_overlap_ratio": readback_overlap_ratio(spans),
         "contention": contention_stats(events),
+        "transport": transport_stats(events),
     }
 
 
@@ -298,6 +338,14 @@ def format_report(report: dict) -> str:
             f"committed={con['committed']} "
             f"abort_rate={con['abort_rate']:.4f} "
             f"throttle_rate={con['throttle_rate']:.4f}")
+    tp = report.get("transport")
+    if tp and tp["frames_in"]:
+        lines.append(
+            f"transport: frames_in={tp['frames_in']} "
+            f"frames_out={tp['frames_out']} "
+            f"checksum_rejects={tp['checksum_rejects']} "
+            f"native_hit_rate={tp['native_hit_rate']:.4f} "
+            f"slow_falls={tp['py_slow_path_falls']}")
     return "\n".join(lines)
 
 

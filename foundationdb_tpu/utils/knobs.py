@@ -250,6 +250,10 @@ KNOBS.init("DD_SHARD_SPLIT_BYTES", 500_000, (5_000,))  # shardSplitter :314 thre
 KNOBS.init("DD_SHARD_MERGE_BYTES", 50_000, (500,))  # shardMerger :379 threshold
 KNOBS.init("STORAGE_DURABILITY_LAG_VERSIONS", 2_000_000)
 KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
+# serve incoming connections through the C transport data plane
+# (net/native_transport.py); NET_NATIVE_TRANSPORT=1 in the environment
+# overrides. Not buggified: the sim never constructs a NetTransport.
+KNOBS.init("NET_NATIVE_TRANSPORT", 0)
 
 # --- Ratekeeper (fdbserver/Ratekeeper.actor.cpp) ---
 KNOBS.init("RATEKEEPER_DEFAULT_LIMIT", 1e9)

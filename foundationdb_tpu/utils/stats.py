@@ -49,7 +49,8 @@ class CounterCollection:
     def as_dict(self) -> dict:
         return {c.name: c.value for c in self.counters}
 
-    def trace(self, now: float, event: str | None = None):
+    def trace(self, now: float, event: str | None = None,
+              extra: dict | None = None):
         """traceCounters (Stats.h:113): one event with values + rates."""
         ev = TraceEvent(event or f"{self.name}Metrics", self.ident)
         dt = (now - self._last_dump_time) if self._last_dump_time else 0.0
@@ -58,15 +59,37 @@ class CounterCollection:
             if dt > 0:
                 ev.detail(c.name + "Rate", round(c.rate_since_dump(dt), 2))
             c._last_dumped = c.value
+        if extra:
+            for k, v in extra.items():
+                ev.detail(k, v)
         self._last_dump_time = now
         ev.log()
 
 
+def fold_transport_counters(process, snap: dict) -> dict:
+    """Merge the process transport's counters (FramesIn/Out, BytesIn/Out,
+    ChecksumRejects, NativeFastPathHits, PySlowPathFalls, ...) into a role's
+    metrics snapshot. The transport is process-wide, so co-hosted roles
+    report the same tallies — the rollup dedupes by process address. A sim
+    network has no transport counters; the snapshot passes through."""
+    tc = getattr(getattr(process, "net", None), "transport_counters", None)
+    if tc is not None:
+        for k, v in tc().items():
+            snap["Transport" + k] = v
+    return snap
+
+
 def trace_counters_loop(process, collection: CounterCollection,
                         interval: float = 5.0):
-    """Spawnable actor: dump the collection every `interval` seconds."""
+    """Spawnable actor: dump the collection every `interval` seconds.
+    Real-network processes also carry the transport tallies in each dump
+    (Transport*-prefixed, same folding as the metrics RPC) so trace_analyze
+    can roll up wire-plane activity from the files alone."""
     async def loop():
         while True:
             await process.net.loop.delay(interval)
-            collection.trace(process.net.loop.now())
+            tc = getattr(process.net, "transport_counters", None)
+            extra = ({"Transport" + k: v for k, v in tc().items()}
+                     if tc is not None else None)
+            collection.trace(process.net.loop.now(), extra=extra)
     return process.spawn(loop(), f"traceCounters/{collection.name}")

@@ -10,8 +10,9 @@
 #   scripts/build_native.sh --sanitize=address,undefined   # ASan/UBSan run
 #
 # --sanitize builds an instrumented variant (-g -O1 -fsanitize=...) and
-# re-runs the three parity fuzzes (VStore read path, redwood block codec,
-# transport framing) against it via scripts/native_sanitize_fuzz.py, with
+# re-runs the parity fuzzes (VStore read path, redwood block codec, wire
+# framing, redwood read path, transport plane) against it via
+# scripts/native_sanitize_fuzz.py, with
 # the sanitizer runtimes LD_PRELOADed into the uninstrumented python and
 # PYTHONMALLOC=malloc so the extension's heap traffic is fully shadowed.
 #
@@ -104,12 +105,18 @@ spec.loader.exec_module(m)
 for sym in ("crc32c", "encode_keys_into", "redwood_encode_block",
             "redwood_decode_block", "redwood_bloom_build",
             "redwood_bloom_query", "redwood_run_open", "redwood_runs_get",
-            "redwood_runs_get_batch", "redwood_runs_get_many_encode"):
+            "redwood_runs_get_batch", "redwood_runs_get_many_encode",
+            "transport_frame", "TransportTable", "TransportConn"):
     assert hasattr(m, sym), f"missing symbol {sym}"
 img = m.redwood_encode_block([(b"a", b"1"), (b"ab", b"2")])
 assert m.redwood_decode_block(img) == [(b"a", b"1"), (b"ab", b"2")]
 sec = m.redwood_bloom_build([b"a", b"ab"], 10, 6)
 assert m.redwood_bloom_query(sec, b"a") is True  # never a false negative
 assert m.crc32c(b"123456789") == 0xE3069283  # CRC-32C check value
+# transport plane: frame round-trips through a conn as one slow tuple
+frame = m.transport_frame(7, 3, 0, b"body")
+assert len(frame) == m.TRANSPORT_HEADER_LEN + 4
+replies, slow, err = m.TransportConn(m.TransportTable()).feed(frame)
+assert replies is None and err is None and slow == [(7, 3, 0, b"body")]
 print("build_native: OK")
 EOF

@@ -89,6 +89,22 @@ def main() -> int:
     TN.fuzz_batched_encode_parity(seed=24)
     print("sanitize_fuzz: redwood read path fuzz OK")
 
+    # 5. Transport plane: frame assembly + the stream parser that eats
+    #    raw socket bytes (torn/corrupted/oversized frames under random
+    #    chunking) + the C fast-path serves that parse requests and emit
+    #    reply frames with computed offsets — the hostile-peer surface.
+    from tests import test_native_transport as TT
+    if not TT.HAVE_NATIVE:
+        print("sanitize_fuzz: build lacks transport plane", file=sys.stderr)
+        return 1
+    for seed in (31, 32):
+        TT.fuzz_frame_parity(seed)
+        TT.fuzz_stream_reject_parity(seed)
+        TT.fuzz_fast_path_parity(seed)
+    TT.test_dead_conn_refuses_more_input()
+    TT.test_counters_track_frames_and_hits()
+    print("sanitize_fuzz: transport plane fuzz OK")
+
     # Leak check now, then skip interpreter finalization: CPython teardown
     # frees in an order that would re-trigger interceptors for no extra
     # coverage. gc.collect() first so dead reference cycles created by the

@@ -262,13 +262,15 @@ def test_multiprocess_restart_recovers_wire_wal(tmp_path):
 
         # hostile bytes at the live port: server must keep serving
         import struct as _struct
-        import zlib as _zlib
+
+        from foundationdb_tpu.net import native_transport as _nt
+        from foundationdb_tpu.net.transport import _CONNECT as _connect
         host, port = p_txn.rsplit(":", 1)
         body = b"\x80\x04junkpickle"
         frame = _struct.pack(">IQQBI", len(body), 10, 1, 0,
-                             _zlib.crc32(body)) + body
-        for blob in (b"\x00" * 64, b"fdbtpu\x01" + b"\xff" * 200,
-                     b"fdbtpu\x01" + frame):
+                             _nt.crc32c(body)) + body
+        for blob in (b"\x00" * 64, _connect + b"\xff" * 200,
+                     _connect + frame):
             s = socket.create_connection((host, int(port)))
             s.sendall(blob)
             s.close()
@@ -331,9 +333,9 @@ def test_framing_fuzz_rejects_garbage_without_wedging():
     still answers a well-formed request afterwards."""
     import asyncio
     import random
-    import zlib
 
     from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.net import native_transport as nt
     from foundationdb_tpu.net import transport as T
     from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
     from foundationdb_tpu.utils import wire
@@ -356,17 +358,17 @@ def test_framing_fuzz_rejects_garbage_without_wedging():
                 return noise
             if shape == 1:  # truncated: header promises more body than sent
                 return T._HEADER.pack(1000, 7, 1, T._REQUEST,
-                                      zlib.crc32(noise)) + noise
+                                      nt.crc32c(noise)) + noise
             if shape == 2:  # corrupted CRC on a well-formed frame
                 return T._HEADER.pack(len(good_body), 7, 1, T._REQUEST,
-                                      zlib.crc32(good_body) ^ 0xDEAD
+                                      nt.crc32c(good_body) ^ 0xDEAD
                                       ) + good_body
             if shape == 3:  # valid CRC, undecodable body
                 return T._HEADER.pack(len(noise), 7, 1, T._REQUEST,
-                                      zlib.crc32(noise)) + noise
+                                      nt.crc32c(noise)) + noise
             # shape 4: unknown frame-kind byte with a decodable body
             return T._HEADER.pack(len(good_body), 7, 1, 9,
-                                  zlib.crc32(good_body)) + good_body
+                                  nt.crc32c(good_body)) + good_body
 
         async def fuzz():
             # raw asyncio (not loop.spawn): the fuzz client speaks bytes,
