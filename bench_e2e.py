@@ -436,9 +436,26 @@ def worker_main(spec: dict):
         g_trace_batch.dump()
         set_sink(None)
         trace_file.close()
+    t = os.times()
     print(json.dumps({"ops": ops, "txns": txns, "grv": _pcts(grv),
-                      "commit": _pcts(com), "errors": errors}),
+                      "commit": _pcts(com), "errors": errors,
+                      # this process's total CPU (user+sys): the client
+                      # side of the phase's CPU split. Includes the boot/
+                      # import constant, identical across ablation rows.
+                      "cpu": round(t[0] + t[1], 3)}),
           flush=True)
+
+
+def _cpu_seconds(pid: int) -> float:
+    """user+sys CPU seconds a process has consumed (/proc/<pid>/stat
+    fields 14+15); 0.0 where /proc is unavailable (the cpu split is then
+    reported as zeros rather than failing the bench)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            rest = f.read().split(b") ", 1)[1].split()
+        return (int(rest[11]) + int(rest[12])) / os.sysconf("SC_CLK_TCK")
+    except (OSError, IndexError, ValueError):
+        return 0.0
 
 
 def _merge_pcts(parts: list[dict]) -> dict:
@@ -538,6 +555,7 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         per = [clients // n_client_procs] * n_client_procs
         per[0] += clients - sum(per)
         for kind in phases:
+            srv_cpu0 = sum(_cpu_seconds(p.pid) for p in procs)
             workers = []
             for k in range(n_client_procs):
                 spec = {"kind": kind, "clients": per[k],
@@ -558,9 +576,17 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
             for w in workers:
                 line = w.stdout.readline().decode()
                 results.append(json.loads(line))
+            # server CPU sampled while the server procs are still alive;
+            # the workers self-reported theirs in the result line (they may
+            # already have exited by now)
+            srv_cpu1 = sum(_cpu_seconds(p.pid) for p in procs)
+            for w in workers:
                 w.wait(timeout=60)
             rate = sum(r["ops"] for r in results) / seconds
             entry = {"ops_per_sec": round(rate, 1)}
+            entry["cpu_split"] = {
+                "server_s": round(srv_cpu1 - srv_cpu0, 2),
+                "client_s": round(sum(r.get("cpu", 0.0) for r in results), 2)}
             if kind in BASELINES:
                 entry["vs_baseline"] = round(rate / BASELINES[kind], 3)
             errs: dict[str, int] = {}
@@ -821,6 +847,51 @@ def run_native_transport(clients: int = 1000, seconds: float = 5.0) -> dict:
     return out
 
 
+def run_native_client(clients: int = 1000, seconds: float = 5.0,
+                      trials: int = 3) -> dict:
+    """The native-client-plane rows for BENCH_r15: the standing r10-shaped
+    e2e read row with BOTH halves of the C data plane on (server transport
+    + client batched-encode/reply-pump), plus the ablation row with only
+    the client half off — so the delta isolates exactly what PR 19 added
+    over the r14 configuration. trace=True for the stage breakdown and
+    the transport counter rollup (ClientNativeSettles must show the
+    replies actually settled through the C pump).
+
+    The rows are the per-row MEDIAN of `trials` INTERLEAVED runs
+    (native, ablation, native, ablation, ...): the bench host is a shared
+    single-core VM whose available cycles drift by tens of percent on a
+    minutes scale, so back-to-back single runs regularly invert a real
+    ordering. Interleaving exposes both rows to the same drift; the
+    per-trial ops/s are kept in the row under "trials"."""
+    runs: dict[str, list] = {"e2e_read_native_client": [],
+                             "e2e_read_python_client": []}
+    for _ in range(trials):
+        for label, on in (("e2e_read_native_client", "1"),
+                          ("e2e_read_python_client", "0")):
+            # env vars (not just knobs): server processes AND client
+            # workers inherit os.environ, and the env override wins on
+            # both sides
+            os.environ["NET_NATIVE_TRANSPORT"] = "1"
+            os.environ["NET_NATIVE_CLIENT"] = on
+            try:
+                runs[label].append(run(
+                    clients=clients, seconds=seconds, backend="oracle",
+                    n_proxies=0, n_storage=1, phases=("read",), trace=True,
+                    extra_knobs={"NET_NATIVE_TRANSPORT": 1,
+                                 "NET_NATIVE_CLIENT": int(on)}))
+            finally:
+                os.environ.pop("NET_NATIVE_TRANSPORT", None)
+                os.environ.pop("NET_NATIVE_CLIENT", None)
+    out: dict = {}
+    for label, reports in runs.items():
+        reports.sort(key=lambda rep: rep["read"]["ops_per_sec"])
+        median = reports[len(reports) // 2]
+        median["read"]["trials"] = [rep["read"]["ops_per_sec"]
+                                    for rep in reports]
+        out[label] = median
+    return out
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(json.loads(sys.argv[2]))
@@ -836,6 +907,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--native-transport" in sys.argv:
         print(json.dumps(run_native_transport(), indent=2))
+        sys.exit(0)
+    if "--native-client" in sys.argv:
+        print(json.dumps(run_native_client(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

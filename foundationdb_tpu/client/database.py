@@ -329,23 +329,43 @@ class Database:
         self._grv_armed = False
         span_id = self._next_span_id("grv")
         t0 = self.loop.now()
-        try:
-            reply = await self.process.net.request(
-                self.process, self._pick_proxy(Token.PROXY_GET_READ_VERSION),
-                GetReadVersionRequest(debug_id=span_id))
-            for w in waiters:
-                if not w.is_ready():
-                    w._set(reply)
-        except FDBError as e:
-            for w in waiters:
-                if not w.is_ready():
-                    w._set_error(FDBError(e.name, e.detail))
-        finally:
-            # both records after the round trip: a cancelled flush must not
+
+        def settle(reply, err):
+            # both records after the round trip: a failed flush must not
             # strand an open span in the trace
-            g_trace_batch.span_begin("CommitSpan", span_id, "Client.GRV", at=t0)
+            g_trace_batch.span_begin("CommitSpan", span_id, "Client.GRV",
+                                     at=t0)
             g_trace_batch.span_end("CommitSpan", span_id, "Client.GRV",
                                    at=self.loop.now())
+            for w in waiters:
+                if not w.is_ready():
+                    if err is not None:
+                        w._set_error(err)
+                    else:
+                        w._set(reply)
+
+        try:
+            inner = self.process.net.request(
+                self.process, self._pick_proxy(Token.PROXY_GET_READ_VERSION),
+                GetReadVersionRequest(debug_id=span_id))
+        except FDBError as e:
+            settle(None, FDBError(e.name, e.detail))
+            return
+
+        # settle the waiters from the reply callback, not after an await:
+        # the version reaches every waiting transaction in the same loop
+        # tick the reply frame settles in, instead of one actor-resume
+        # later (the frame-to-future collapse of the native client plane)
+        def on_reply(s: Future):
+            if s.is_error():
+                e = s._result
+                if isinstance(e, FDBError):
+                    e = FDBError(e.name, e.detail)
+                settle(None, e)
+            else:
+                settle(s._result, None)
+
+        inner.add_callback(on_reply)
 
     async def _ensure_locations(self):
         if not self.locations.valid:
@@ -611,6 +631,48 @@ class Database:
                 for kk in k:
                     append((kk, v))
         req = GetValuesRequest(reads=reads)
+        order = self._team_order(team)
+        if len(order) == 1:
+            # single-replica fast path, collapsed to a reply callback: the
+            # batch's futures settle in the SAME loop tick the reply frame
+            # arrives in, instead of resuming this coroutine first (one
+            # loop-schedule hop per batch — the client-side half of the
+            # frame-to-future path; the hedged path below keeps the
+            # coroutine since it genuinely multiplexes attempts).
+            addr = order[0]
+            stats = self._replica_stats
+            span_id = self._next_span_id("read")
+            t0 = self.loop.now()
+            inner = self.process.net.request(
+                self.process, Endpoint(addr, Token.STORAGE_GET_VALUES), req)
+
+            def on_reply(s: Future):
+                g_trace_batch.span_begin("CommitSpan", span_id,
+                                         "Client.Read", at=t0)
+                g_trace_batch.span_end("CommitSpan", span_id, "Client.Read",
+                                       at=self.loop.now())
+                if not s.is_error():
+                    stats.record(addr, self.loop.now() - t0)
+                    self._distribute_read_results(ents, s._result.results,
+                                                  flat)
+                    return
+                e = s._result
+                if not isinstance(e, FDBError) \
+                        or e.name == "operation_cancelled":
+                    for _k, _v, f in ents:
+                        if not f.is_ready():
+                            f._set_error(e)
+                    return
+                # whole-batch failure (replica down, future_version, stale
+                # shard): per-entry re-resolution, as the awaited path
+                if e.name == "wrong_shard_server" and self.coordinators:
+                    self.locations.invalidate()
+                for k, v, f in ents:
+                    if not f.is_ready():
+                        self._read_fallback(k, v, f)
+
+            inner.add_callback(on_reply)
+            return
         try:
             rep = await self._on_team(
                 team, lambda addr: self.process.net.request(
@@ -626,8 +688,14 @@ class Database:
                 if not f.is_ready():
                     self._read_fallback(k, v, f)
             return
+        self._distribute_read_results(ents, rep.results, flat)
+
+    def _distribute_read_results(self, ents, results, flat: bool) -> None:
+        """Fan one GetValuesReply back out to the batch's futures: parallel
+        to the request's reads, (0, value) per key or (1, error name) for
+        per-key failures (wrong_shard_server re-resolves individually)."""
         if flat:
-            for (k, v, f), (code, payload) in zip(ents, rep.results):
+            for (k, v, f), (code, payload) in zip(ents, results):
                 if f.is_ready():
                     continue
                 if code == 0:
@@ -639,7 +707,6 @@ class Database:
                 else:
                     f._set_error(FDBError(payload))
             return
-        results = rep.results
         i = 0
         for k, v, f in ents:
             if type(k) is bytes:

@@ -138,9 +138,17 @@ class Transaction:
             has_point = False
             point = None
         if self._read_version is None:
-            # no read version yet: fall back to the coroutine path (it
-            # fetches one); callers batching reads fetch the GRV first
-            return self.db.loop.spawn(self.get(key, snapshot), "get")
+            if w.mutations:
+                # overlay atop an unfetched GRV: the coroutine path merges
+                # pending atomic ops correctly; rare enough to spawn
+                return self.db.loop.spawn(self.get(key, snapshot), "get")
+            # no read version yet: fetch the GRV once and chain the read
+            # off its callback — no per-key coroutine in between, so the
+            # value future still settles in the tick its reply frame lands
+            if not snapshot:
+                self._read_conflict_keys.append(key)
+            return self._chain_grv_read(
+                lambda: self.db._read_get(key, self._read_version))
         inner = self.db._read_get(key, self._read_version)
         if self._opt_timeout_ms is not None:
             inner = self.db.loop.timeout(inner, self._opt_timeout_ms / 1000.0)
@@ -151,6 +159,9 @@ class Transaction:
         out = Future()
 
         def relay(f):
+            # direct settle from the batcher future's callback: when the
+            # native client plane settles the batch from a reply frame,
+            # this fires in the same tick — no scheduled second relay
             if out.is_ready():
                 return
             if f.is_error():
@@ -158,6 +169,40 @@ class Transaction:
             else:
                 out._set(point.resolve(f._result))
         inner.add_callback(relay)
+        return out
+
+    def _chain_grv_read(self, issue) -> Future:
+        """GRV-then-read as a callback chain: fetch the batched read
+        version, and from ITS settle callback enqueue the read built by
+        `issue()` (which sees self._read_version) and relay the result —
+        the no-coroutine composition of get_read_version + read batcher
+        that get_future/get_many use when no read version is set yet."""
+        out = Future()
+        grvf = self._deadline_guard(self.db._grv())
+
+        def relay(f):
+            if out.is_ready():
+                return
+            if f.is_error():
+                out._set_error(f._result)
+            else:
+                out._set(f._result)
+
+        def on_grv(g):
+            if out.is_ready():
+                return
+            if g.is_error():
+                out._set_error(g._result)
+                return
+            if self._read_version is None:
+                self._read_version = g._result.version
+            inner = issue()
+            if self._opt_timeout_ms is not None:
+                inner = self.db.loop.timeout(
+                    inner, self._opt_timeout_ms / 1000.0)
+            inner.add_callback(relay)
+
+        grvf.add_callback(on_grv)
         return out
 
     def get_many(self, keys, snapshot: bool = False):
@@ -168,13 +213,21 @@ class Transaction:
         ONE queue entry resolving ONE future, so a read transaction's
         client-side cost no longer scales with per-key future machinery."""
         w = self._writes
-        if w.mutations or self._read_version is None:
-            # overlay merge or GRV fetch needed: compose the per-key path
+        if w.mutations:
+            # overlay merge needed: compose the per-key path
             return all_of([self.get_future(k, snapshot) for k in keys])
         limit = self._key_limit
         for k in keys:
             if len(k) > limit:
                 raise FDBError("key_too_large")
+        if self._read_version is None:
+            # GRV fetch needed: one chained fetch for the whole multiget,
+            # not a per-key coroutine fan-out
+            keys = list(keys)
+            if not snapshot:
+                self._read_conflict_keys.extend(keys)
+            return self._chain_grv_read(
+                lambda: self.db._read_get_many(keys, self._read_version))
         inner = self.db._read_get_many(keys, self._read_version)
         if self._opt_timeout_ms is not None:
             inner = self.db.loop.timeout(inner, self._opt_timeout_ms / 1000.0)

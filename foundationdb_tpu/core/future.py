@@ -124,6 +124,26 @@ def settle_failed(reply: Promise, e: BaseException) -> None:
     reply.send_error(e)
 
 
+def settle_many(settlements) -> None:
+    """Settle a batch of promises synchronously, in order.
+
+    `settlements` is a list of (promise, value, error) triples — error is
+    None for a value settlement. One native reply batch (a ClientConn.feed
+    over a socket read) resolves every future it carries from a single
+    call in a single loop tick: each settle fires its callbacks inline,
+    and only the awaiting actors' resumes go back through the loop, so
+    the per-future schedule hop of settling one-by-one from a coroutine
+    disappears. Already-settled promises (request expired, duplicate
+    reply) are skipped, matching the reply loop's dedup discipline."""
+    for p, value, error in settlements:
+        if p.is_set():
+            continue
+        if error is not None:
+            p.send_error(error)
+        else:
+            p.send(value)
+
+
 class PromiseStream:
     """Multi-value stream: send() many values; receivers pop() Futures.
 

@@ -4152,6 +4152,280 @@ static PyTypeObject TransportConnType = {
     .tp_doc = "one connection's native frame loop over a TransportTable",
 };
 
+/* ------------------------------------------------------------------ */
+/* Native client plane (net/native_transport.py client binding)        */
+/*                                                                     */
+/* The inverse of the server section above: the client's hot read      */
+/* tokens (GET_VALUE / GET_VALUES / GET_KEY_VALUES / GRV) spend their  */
+/* wire time in two per-request Python round trips — wire.dumps +      */
+/* frame + crc32c on send, readexactly + header unpack + wire.loads    */
+/* on receive. transport_client_encode() collapses the send side to    */
+/* one C call per socket write; ClientConn collapses the receive side  */
+/* to one C call per socket read that hands back a settled-batch the   */
+/* Python loop resolves futures from. Request/reply payloads ride the  */
+/* generic registered-struct codec (enc_value / dec_value), so the     */
+/* client plane transports the pinned schemas below (PROTO005 holds    */
+/* the field lists against the Python dataclasses).                    */
+/* Anything the codec cannot express raises OverflowError and the      */
+/* Python wrapper re-runs the pure-Python path, which stays the        */
+/* semantic authority (three-way fuzz: tests/test_native_client.py).   */
+/*
+     GetValueRequest { key: bytes, version: int }
+     GetValuesRequest { reads: list }
+     GetKeyValuesRequest { begin: KeySelector, end: KeySelector,
+                           version: int, limit: int, limit_bytes: int,
+                           reverse: bool }
+     GetReadVersionRequest { priority: int, debug_id: str|None }
+*/
+/* ------------------------------------------------------------------ */
+
+/* transport_client_encode([(token, reply_id, payload), ...]) -> bytes
+ * One framed, CRC-stamped send buffer for the whole batch, byte-
+ * identical to concatenating transport_frame(token, reply_id, REQUEST,
+ * wire.dumps(payload)) per item. */
+static PyObject *py_transport_client_encode(PyObject *self, PyObject *arg) {
+    PyObject *seq = PySequence_Fast(arg, "encode batch must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    WBuf out = {NULL, 0, 0};
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+            PyErr_SetString(
+                PyExc_TypeError,
+                "encode batch item must be (token, reply_id, payload)");
+            goto fail;
+        }
+        uint64_t token =
+            PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(item, 0));
+        if (token == (uint64_t)-1 && PyErr_Occurred())
+            goto fail;
+        uint64_t reply_id =
+            PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(item, 1));
+        if (reply_id == (uint64_t)-1 && PyErr_Occurred())
+            goto fail;
+        Py_ssize_t hoff = out.len;
+        if (wb_grow(&out, TP_HEADER_LEN + 64) < 0)
+            goto fail;
+        out.len += TP_HEADER_LEN; /* header backfilled once the body
+                                     length and CRC are known */
+        Py_ssize_t boff = out.len;
+        if (wb_byte(&out, W_MAGIC) < 0 || wb_byte(&out, W_VERSION) < 0 ||
+            enc_value(&out, PyTuple_GET_ITEM(item, 2), 0) < 0)
+            goto fail; /* OverflowError -> wrapper falls back */
+        Py_ssize_t blen = out.len - boff;
+        if (blen > TP_MAX_FRAME) {
+            PyErr_SetString(PyExc_ValueError,
+                            "frame body over TP_MAX_FRAME");
+            goto fail;
+        }
+        uint32_t crc;
+        if (blen >= TP_GIL_CRC_MIN) {
+            Py_BEGIN_ALLOW_THREADS
+            crc = crc32c_sw(0, out.buf + boff, blen);
+            Py_END_ALLOW_THREADS
+        } else {
+            crc = crc32c_sw(0, out.buf + boff, blen);
+        }
+        /* out.buf may have moved during enc_value: locate the header
+         * through the stable offset, never a saved pointer */
+        uint8_t *h = out.buf + hoff;
+        tp_store_u32(h, (uint32_t)blen);
+        tp_store_u64(h + 4, token);
+        tp_store_u64(h + 12, reply_id);
+        h[20] = TP_REQUEST;
+        tp_store_u32(h + 21, crc);
+    }
+    PyObject *ret =
+        PyBytes_FromStringAndSize((const char *)out.buf, out.len);
+    PyMem_Free(out.buf);
+    Py_DECREF(seq);
+    return ret;
+fail:
+    PyMem_Free(out.buf);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* -- ClientConn: one outbound connection's reply pump -- */
+
+typedef struct {
+    PyObject_HEAD
+    uint8_t *rx;
+    Py_ssize_t rx_len, rx_cap;
+    int dead;
+} ClientConn;
+
+static int cc_reserve(ClientConn *self, Py_ssize_t extra) {
+    Py_ssize_t need = self->rx_len + extra;
+    if (need <= self->rx_cap)
+        return 0;
+    Py_ssize_t cap = self->rx_cap * 2;
+    if (cap < need)
+        cap = need + 4096;
+    uint8_t *nb = PyMem_Realloc(self->rx, cap);
+    if (!nb) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    self->rx = nb;
+    self->rx_cap = cap;
+    return 0;
+}
+
+static PyObject *cc_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+    if ((kwds != NULL && PyDict_GET_SIZE(kwds) > 0) ||
+        (args != NULL && PyTuple_GET_SIZE(args) > 0)) {
+        PyErr_SetString(PyExc_TypeError, "ClientConn takes no arguments");
+        return NULL;
+    }
+    return type->tp_alloc(type, 0);
+}
+
+static void cc_dealloc(ClientConn *self) {
+    PyMem_Free(self->rx);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+/* feed(data) -> ([(reply_id, kind, payload, raw), ...], err|None).
+ * Complete frames are consumed; a torn tail stays buffered. Each entry
+ * carries either a C-decoded payload (raw is None) or, when the body
+ * needs the Python codec — >64-bit varints, schema skew, unknown ids,
+ * an older wire version — payload is None and raw holds the CRC-
+ * verified body for wire.loads (the per-frame fallback the wrapper
+ * counts as ClientPyFalls). `err` reports the first protocol reject
+ * (checksum mismatch / oversized length) in-band so entries parsed
+ * earlier in the same chunk still settle their futures before the
+ * caller drops the connection — matching the Python loop's order.
+ * Divergence from the Python loop, documented in
+ * docs/native_transport.md: the pump CRC-checks every frame including
+ * ones whose reply_id no longer has a pending future (the Python loop
+ * skips verification for those), so a corrupt late duplicate kills the
+ * connection here but is ignored there. Strictly stricter. */
+static PyObject *cc_feed(ClientConn *self, PyObject *args) {
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "y*", &data))
+        return NULL;
+    if (self->dead) {
+        PyBuffer_Release(&data);
+        PyErr_SetString(PyExc_ValueError,
+                        "feed() on a failed client connection");
+        return NULL;
+    }
+    if (cc_reserve(self, data.len) < 0) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    memcpy(self->rx + self->rx_len, data.buf, data.len);
+    self->rx_len += data.len;
+    PyBuffer_Release(&data);
+
+    const char *err = NULL;
+    PyObject *entries = PyList_New(0);
+    if (!entries)
+        return NULL;
+    Py_ssize_t pos = 0;
+    while (self->rx_len - pos >= TP_HEADER_LEN) {
+        const uint8_t *h = self->rx + pos;
+        Py_ssize_t length = (Py_ssize_t)tp_load_u32(h);
+        if (length > TP_MAX_FRAME) {
+            err = "oversized frame";
+            break;
+        }
+        if (self->rx_len - pos - TP_HEADER_LEN < length)
+            break; /* torn frame: keep the prefix for the next feed */
+        uint64_t reply_id = tp_load_u64(h + 12);
+        int kind = h[20];
+        uint32_t want = tp_load_u32(h + 21);
+        const uint8_t *fb = h + TP_HEADER_LEN;
+        uint32_t got;
+        if (length >= TP_GIL_CRC_MIN) {
+            Py_BEGIN_ALLOW_THREADS
+            got = crc32c_sw(0, fb, length);
+            Py_END_ALLOW_THREADS
+        } else {
+            got = crc32c_sw(0, fb, length);
+        }
+        if (got != want) {
+            err = "packet checksum mismatch";
+            break;
+        }
+        pos += TP_HEADER_LEN + length;
+        PyObject *payload = NULL;
+        if ((kind == TP_REPLY || kind == TP_REPLY_ERROR) && length >= 2 &&
+            fb[0] == W_MAGIC && fb[1] == W_VERSION) {
+            RBuf r = {fb + 2, fb + length};
+            payload = dec_value(&r, 0);
+            if (payload && r.p != r.end)
+                Py_CLEAR(payload); /* trailing bytes: Python owns reject */
+            if (!payload)
+                PyErr_Clear(); /* per-frame fallback, never an error */
+        }
+        PyObject *tup;
+        if (payload) {
+            tup = Py_BuildValue("(KiOO)", reply_id, kind, payload, Py_None);
+            Py_DECREF(payload);
+        } else {
+            tup = Py_BuildValue("(KiOy#)", reply_id, kind, Py_None,
+                                (const char *)fb, length);
+        }
+        if (!tup)
+            goto fail;
+        int rc = PyList_Append(entries, tup);
+        Py_DECREF(tup);
+        if (rc < 0)
+            goto fail;
+    }
+    if (pos > 0) {
+        memmove(self->rx, self->rx + pos, self->rx_len - pos);
+        self->rx_len -= pos;
+    }
+    if (err != NULL)
+        self->dead = 1;
+    PyObject *err_obj = err ? PyUnicode_FromString(err) : Py_NewRef(Py_None);
+    if (!err_obj)
+        goto fail;
+    PyObject *ret = PyTuple_New(2);
+    if (!ret) {
+        Py_DECREF(err_obj);
+        goto fail;
+    }
+    PyTuple_SET_ITEM(ret, 0, entries);
+    PyTuple_SET_ITEM(ret, 1, err_obj);
+    return ret;
+fail:
+    Py_DECREF(entries);
+    return NULL;
+}
+
+/* residue() -> buffered-but-unparsed bytes, for handing the connection
+ * back to the pure-Python reply loop mid-stream */
+static PyObject *cc_residue(ClientConn *self, PyObject *noarg) {
+    (void)noarg;
+    if (self->rx_len == 0)
+        return PyBytes_FromStringAndSize("", 0);
+    return PyBytes_FromStringAndSize((const char *)self->rx, self->rx_len);
+}
+
+static PyMethodDef cc_methods[] = {
+    {"feed", (PyCFunction)cc_feed, METH_VARARGS,
+     "feed(data) -> ([(reply_id, kind, payload, raw), ...], err|None)"},
+    {"residue", (PyCFunction)cc_residue, METH_NOARGS,
+     "residue() -> buffered unparsed bytes"},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject ClientConnType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "fdb_native.ClientConn",
+    .tp_basicsize = sizeof(ClientConn),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_new = cc_new,
+    .tp_dealloc = (destructor)cc_dealloc,
+    .tp_methods = cc_methods,
+    .tp_doc = "one outbound connection's native reply pump",
+};
+
 static PyMethodDef methods[] = {
     {"crc32c", py_crc32c, METH_VARARGS,
      "crc32c(data, init=0) -> CRC-32C checksum"},
@@ -4190,6 +4464,10 @@ static PyMethodDef methods[] = {
     {"transport_frame", py_transport_frame, METH_VARARGS,
      "transport_frame(token, reply_id, kind, body) -> framed bytes "
      "(byte-identical to transport.py _frame)"},
+    {"transport_client_encode", py_transport_client_encode, METH_O,
+     "transport_client_encode([(token, reply_id, payload), ...]) -> one "
+     "framed, CRC-stamped send buffer (byte-identical to per-request "
+     "wire.dumps + transport_frame)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {
@@ -4200,7 +4478,8 @@ PyMODINIT_FUNC PyInit_fdb_native(void) {
     if (PyType_Ready(&OMapType) < 0 || PyType_Ready(&VStoreType) < 0 ||
         PyType_Ready(&RedwoodRunType) < 0 ||
         PyType_Ready(&TransportTableType) < 0 ||
-        PyType_Ready(&TransportConnType) < 0)
+        PyType_Ready(&TransportConnType) < 0 ||
+        PyType_Ready(&ClientConnType) < 0)
         return NULL;
     g_zero = PyLong_FromLong(0);
     g_too_old_pair = Py_BuildValue("(is)", 1, TOO_OLD_NAME);
@@ -4245,6 +4524,13 @@ PyMODINIT_FUNC PyInit_fdb_native(void) {
     if (PyModule_AddObject(m, "TransportConn",
                            (PyObject *)&TransportConnType) < 0) {
         Py_DECREF(&TransportConnType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&ClientConnType);
+    if (PyModule_AddObject(m, "ClientConn", (PyObject *)&ClientConnType)
+            < 0) {
+        Py_DECREF(&ClientConnType);
         Py_DECREF(m);
         return NULL;
     }

@@ -145,3 +145,63 @@ def grv_wire_ids() -> tuple:
     return (si.Token.PROXY_GET_READ_VERSION,
             wire._BY_TYPE[si.GetReadVersionRequest],
             wire._BY_TYPE[si.GetReadVersionReply])
+
+
+# --------------------------------------------------------------------------
+# Client plane (PR 19): batched request encode + reply pump.
+# Same ownership split as the server plane above: this module gates and
+# binds, net/transport.py adopts, tests/test_native_client.py holds the C
+# side byte/decision-identical to the pure-Python references below.
+# --------------------------------------------------------------------------
+
+_REQUEST_KIND = 0  # transport.py _REQUEST; the encoder only emits requests
+
+
+def client_available() -> bool:
+    """True when the C extension carries the client plane symbols."""
+    return (native.available()
+            and hasattr(native.mod, "ClientConn")
+            and hasattr(native.mod, "transport_client_encode"))
+
+
+def client_enabled() -> bool:
+    """The NET_NATIVE_CLIENT gate: env var wins (bench workers export it),
+    else the knob — mirroring enabled() above."""
+    env = os.environ.get("NET_NATIVE_CLIENT")
+    if env is not None:
+        return env == "1"
+    try:
+        from foundationdb_tpu.utils.knobs import KNOBS
+        return bool(getattr(KNOBS, "NET_NATIVE_CLIENT", 0))
+    except Exception:  # noqa: BLE001 — knobs unavailable == gate closed
+        return False
+
+
+def new_client_conn():
+    """A per-connection ClientConn reply pump, or None when the client
+    plane is unavailable (the caller runs the pure-Python reply loop)."""
+    if not client_available():
+        return None
+    from foundationdb_tpu.utils import wire
+    wire._ensure_registry()  # the pump's dec_value needs the registry
+    return native.mod.ClientConn()
+
+
+def encode_batch(items) -> bytes:
+    """One framed, CRC-stamped send buffer for a batch of
+    (token, reply_id, payload) requests. Raises (OverflowError for
+    payloads only the Python codec can express) instead of guessing —
+    the caller falls back to the per-request Python path."""
+    from foundationdb_tpu.utils import wire
+    wire._ensure_registry()  # enc_value resolves dataclasses through it
+    return native.mod.transport_client_encode(items)
+
+
+def py_encode_batch(items) -> bytes:
+    """Pure-Python batch encoder — the parity-fuzz reference: the exact
+    per-request bytes transport.py's fallback path would write."""
+    from foundationdb_tpu.utils import wire
+    wire._ensure_registry()
+    return b"".join(
+        py_frame(token, reply_id, _REQUEST_KIND, wire._py_dumps(payload))
+        for token, reply_id, payload in items)

@@ -254,6 +254,10 @@ KNOBS.init("DESIRED_TOTAL_BYTES", 150_000)  # range-read reply soft limit
 # (net/native_transport.py); NET_NATIVE_TRANSPORT=1 in the environment
 # overrides. Not buggified: the sim never constructs a NetTransport.
 KNOBS.init("NET_NATIVE_TRANSPORT", 0)
+# client half of the data plane: batched C request encode + C reply pump
+# (ClientConn) on outbound connections; NET_NATIVE_CLIENT=1 in the
+# environment overrides. Same no-buggify rationale as above.
+KNOBS.init("NET_NATIVE_CLIENT", 0)
 
 # --- Ratekeeper (fdbserver/Ratekeeper.actor.cpp) ---
 KNOBS.init("RATEKEEPER_DEFAULT_LIMIT", 1e9)

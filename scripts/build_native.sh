@@ -106,7 +106,8 @@ for sym in ("crc32c", "encode_keys_into", "redwood_encode_block",
             "redwood_decode_block", "redwood_bloom_build",
             "redwood_bloom_query", "redwood_run_open", "redwood_runs_get",
             "redwood_runs_get_batch", "redwood_runs_get_many_encode",
-            "transport_frame", "TransportTable", "TransportConn"):
+            "transport_frame", "TransportTable", "TransportConn",
+            "transport_client_encode", "ClientConn"):
     assert hasattr(m, sym), f"missing symbol {sym}"
 img = m.redwood_encode_block([(b"a", b"1"), (b"ab", b"2")])
 assert m.redwood_decode_block(img) == [(b"a", b"1"), (b"ab", b"2")]
@@ -118,5 +119,9 @@ frame = m.transport_frame(7, 3, 0, b"body")
 assert len(frame) == m.TRANSPORT_HEADER_LEN + 4
 replies, slow, err = m.TransportConn(m.TransportTable()).feed(frame)
 assert replies is None and err is None and slow == [(7, 3, 0, b"body")]
+# client plane: a non-reply kind pumps through as a raw entry (payload
+# decode needs the Python wire registry, absent in this bare import)
+entries, err = m.ClientConn().feed(frame)
+assert err is None and entries == [(3, 0, None, b"body")]
 print("build_native: OK")
 EOF

@@ -105,6 +105,23 @@ def main() -> int:
     TT.test_counters_track_frames_and_hits()
     print("sanitize_fuzz: transport plane fuzz OK")
 
+    # 6. Client plane: the batched request encoder (hot-token requests +
+    #    arbitrary payloads, byte-parity against the Python framer) and the
+    #    ClientConn reply pump eating torn/corrupted/undecodable reply
+    #    streams under random chunking — the client's hostile-peer surface,
+    #    where the pump's varint/field walks index into raw socket bytes.
+    from tests import test_native_client as TC
+    if not TC.HAVE_NATIVE:
+        print("sanitize_fuzz: build lacks client plane", file=sys.stderr)
+        return 1
+    for seed in (41, 42):
+        TC.fuzz_encode_parity(seed)
+        TC.fuzz_reply_pump_parity(seed)
+    TC.test_encode_unsupported_payload_raises_for_whole_batch()
+    TC.test_pump_error_reply_with_detail_decodes()
+    TC.test_pump_dead_latch_and_residue()
+    print("sanitize_fuzz: client plane fuzz OK")
+
     # Leak check now, then skip interpreter finalization: CPython teardown
     # frees in an order that would re-trigger interceptors for no extra
     # coverage. gc.collect() first so dead reference cycles created by the
