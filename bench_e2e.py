@@ -77,8 +77,20 @@ def _spawn_server(spec: dict, env: dict) -> subprocess.Popen:
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
 
 
+def _kill_stray_servers():
+    """Kill server/worker processes leaked by a previous crashed or killed
+    bench run. The host is a single shared core: one stray `server_main`
+    spinning in the background taxes every subsequent measurement by tens
+    of percent, and unlike host-load drift the tax is one-sided — it never
+    averages out across interleaved trials."""
+    for pat in ("foundationdb_tpu.net.server_main", "bench_e2e.py --worker"):
+        subprocess.run(["pkill", "-f", pat], stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL, check=False)
+
+
 def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
-                  trace_dir=None, extra_knobs=None, n_grv_proxies=0):
+                  trace_dir=None, extra_knobs=None, n_grv_proxies=0,
+                  n_replicas=1):
     from foundationdb_tpu.server.interfaces import Token
 
     txn_knobs = {"CONFLICT_BACKEND": backend}
@@ -135,14 +147,23 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
     # dedicated GRV proxies always get their own processes: a GRV-only role
     # co-located with a commit proxy would displace its GRV/ping tokens
     p_grv = [f"127.0.0.1:{_free_port()}" for _ in range(n_grv_proxies)]
-    p_storages = [f"127.0.0.1:{_free_port()}" for _ in range(n_storage)]
+    # n_storage SHARDS x n_replicas copies each; storage proc (s, r) has
+    # tag s*R + r, and shard s's mutations carry ALL R of its tags — the
+    # proxy routes each mutation to every team member's tag, so replication
+    # happens through the log, never server-to-server (the recruited-
+    # cluster shape from clustercontroller storage-team recruitment)
+    p_storages = [f"127.0.0.1:{_free_port()}"
+                  for _ in range(n_storage * n_replicas)]
+    teams = [p_storages[s * n_replicas:(s + 1) * n_replicas]
+             for s in range(n_storage)]
 
     # keyspace split into n_storage contiguous shards over k%06d
     cut_keys = [b"k%06d" % (KEYS * i // n_storage)
                 for i in range(1, n_storage)]
     boundaries = [b""] + cut_keys
     shard_spec = {"boundaries": [b.hex() for b in boundaries],
-                  "tags": [[t] for t in range(n_storage)]}
+                  "tags": [[s * n_replicas + r for r in range(n_replicas)]
+                           for s in range(n_storage)]}
 
     def proxy_role(i, addr):
         return {"role": "proxy", "args": {
@@ -201,9 +222,12 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
         })
     storage_specs = []
     for t, addr in enumerate(p_storages):
+        # flat index IS the tag: proc (shard s, replica r) sits at s*R + r
+        name = (f"storage{t}" if n_replicas == 1
+                else f"storage{t // n_replicas}r{t % n_replicas}")
         storage_specs.append({
             "listen": addr,
-            "data_dir": os.path.join(tmp, f"storage{t}"),
+            "data_dir": os.path.join(tmp, name),
             # storage processes need the engine knobs too (STORAGE_ENGINE,
             # REDWOOD_*) — without this an engine override in extra_knobs
             # silently reached only the txn subsystem
@@ -238,8 +262,11 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
         flags.append(f"--xla_force_host_platform_device_count={host_devices}")
         core_env["XLA_FLAGS"] = " ".join(flags)
     procs = [_spawn_server(core_spec, core_env)]
+    # labels aligned with `procs`: the per-process CPU split keys on these
+    labels = ["core"]
     for spec in proxy_specs + storage_specs:
         procs.append(_spawn_server(spec, env))
+        labels.append(os.path.basename(spec["data_dir"]))
     # bounded boot: a device-backend core can hang for minutes attaching a
     # remote accelerator that has not released its previous client; kill
     # the whole boot instead of stalling the bench forever
@@ -267,22 +294,52 @@ def _boot_cluster(tmp, backend="oracle", n_proxies=2, n_storage=2,
             buf += chunk
         sel.close()
         assert buf.startswith(b"ready"), buf[:120]
-    return procs, p_proxies, boundaries, p_storages, p_grv
+    return procs, labels, p_proxies, boundaries, teams, p_grv
 
 
 # ---------------------------------------------------------------- client side
 
-def _make_db(loop, proxies, boundaries, storages, grv_proxies=None):
+def _make_db(loop, proxies, boundaries, teams, grv_proxies=None):
     from foundationdb_tpu.client.database import Database, LocationCache
     from foundationdb_tpu.net.transport import NetTransport
 
     client = NetTransport(loop, f"127.0.0.1:{_free_port()}")
     client.start()
+    # teams: one replica address LIST per shard — a multi-address team puts
+    # the shard's reads through the EWMA balancer + hedged-backup path
     db = Database(client.process, proxies=list(proxies),
                   locations=LocationCache(list(boundaries),
-                                          [[s] for s in storages]),
+                                          [list(t) for t in teams]),
                   grv_proxies=list(grv_proxies or []))
     return client, db
+
+
+def _storage_counters(storages: list[str]) -> dict:
+    """Counter snapshot from every storage process over the real wire (the
+    status fan-out's STORAGE_METRICS endpoint) — the ledger the cache-hit
+    and per-replica-load claims are checked against."""
+    from foundationdb_tpu.core.sim import Endpoint
+    from foundationdb_tpu.net.transport import NetTransport, RealEventLoop
+    from foundationdb_tpu.server.interfaces import Token
+
+    loop = RealEventLoop()
+    client = NetTransport(loop, f"127.0.0.1:{_free_port()}")
+    client.start()
+    out: dict = {}
+
+    async def fetch():
+        for a in storages:
+            try:
+                snap = await loop.timeout(client.process.net.request(
+                    client.process, Endpoint(a, Token.STORAGE_METRICS),
+                    None), 5.0)
+                out[a] = dict(snap)
+            except Exception:  # noqa: BLE001 — a dead replica reports as {}
+                out[a] = {}
+
+    loop.run_future(loop.spawn(fetch()), max_time=30.0)
+    client.close()
+    return out
 
 
 async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
@@ -316,14 +373,26 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
         rnd = random.Random(cid).random
         writing, mixed = kind == "write", kind == "mixed"
         contended = kind == "mixed-contended"
+        zipf_read = kind == "zipfian-read"
+        reading = kind == "read" or mixed or zipf_read
         wval = b"w" * 16
         keytab = _KEYTAB
+        it = 0
         while time.perf_counter() < stop_at:
             tr = db.create_transaction()
+            it += 1
             try:
-                t0 = time.perf_counter()
-                await tr.get_read_version()
-                grv_lat.append(time.perf_counter() - t0)
+                # read-path transactions no longer await the GRV up front:
+                # get_many chains the batched GRV fetch into its own reply
+                # callback (one await per txn, not two — the residual
+                # per-await loop tax was the read bench's top cost). Every
+                # 16th txn still awaits it explicitly so the GRV latency
+                # percentiles keep flowing; write/contended phases keep the
+                # per-txn await (unchanged vs earlier rounds).
+                if not reading or it % 16 == 1:
+                    t0 = time.perf_counter()
+                    await tr.get_read_version()
+                    grv_lat.append(time.perf_counter() - t0)
                 n = 10
                 wrote = False
                 reads = []
@@ -354,6 +423,14 @@ async def _run_phase(loop, db, kind, clients, seconds, ramp: float = 1.5):
                     # conflict in this phase is a hot-range write-write
                     # collision the throttle loop can act on
                     reads = [keytab[HOT_KEYS + int(rnd() * (KEYS - HOT_KEYS))]
+                             for _ in range(n)]
+                    await tr.get_many(reads)
+                elif zipf_read:
+                    # zipfian read hotspot: 80% of draws from the 64-key
+                    # zipfian-hot prefix, the rest uniform over the cold
+                    # tail — the skew the storage read cache must absorb
+                    reads = [keytab[_zipf_idx(rnd())] if rnd() < 0.8 else
+                             keytab[HOT_KEYS + int(rnd() * (KEYS - HOT_KEYS))]
                              for _ in range(n)]
                     await tr.get_many(reads)
                 else:
@@ -419,7 +496,7 @@ def worker_main(spec: dict):
     loop = RealEventLoop()
     client, db = _make_db(loop, spec["proxies"],
                           [bytes.fromhex(b) for b in spec["boundaries"]],
-                          spec["storages"],
+                          spec["teams"],
                           grv_proxies=spec.get("grv_proxies"))
     print("ready", flush=True)
     assert sys.stdin.readline().strip() == "GO"
@@ -439,6 +516,9 @@ def worker_main(spec: dict):
     t = os.times()
     print(json.dumps({"ops": ops, "txns": txns, "grv": _pcts(grv),
                       "commit": _pcts(com), "errors": errors,
+                      # replica balancer ledger: hedge/failover/fallback
+                      # counters + per-replica EWMA, folded per phase
+                      "lb": db.lb_snapshot(),
                       # this process's total CPU (user+sys): the client
                       # side of the phase's CPU split. Includes the boot/
                       # import constant, identical across ablation rows.
@@ -491,18 +571,22 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
         n_proxies: int = 0, n_storage: int = 1,
         n_client_procs: int = 2, trace: bool = False,
         phases: tuple = ("write", "read", "mixed"),
-        extra_knobs: dict | None = None, n_grv_proxies: int = 0) -> dict:
+        extra_knobs: dict | None = None, n_grv_proxies: int = 0,
+        n_replicas: int = 1) -> dict:
     """One pass per phase; returns the report dict."""
     from foundationdb_tpu.net.transport import RealEventLoop
 
+    _kill_stray_servers()
     tmp = tempfile.mkdtemp(prefix="fdbtpu-bench-")
     trace_dir = None
     if trace:
         trace_dir = os.path.join(tmp, "traces")
         os.makedirs(trace_dir, exist_ok=True)
-    procs, p_proxies, boundaries, p_storages, p_grv = _boot_cluster(
+    procs, labels, p_proxies, boundaries, teams, p_grv = _boot_cluster(
         tmp, backend, n_proxies, n_storage, trace_dir=trace_dir,
-        extra_knobs=extra_knobs, n_grv_proxies=n_grv_proxies)
+        extra_knobs=extra_knobs, n_grv_proxies=n_grv_proxies,
+        n_replicas=n_replicas)
+    p_storages = [a for t in teams for a in t]
     # topology records what was actually RECRUITED, not the requested knobs:
     # the merged layout runs one co-located commit proxy, not zero (the r09
     # rows said "proxies": 0 for a run that had one)
@@ -510,6 +594,7 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
                     "topology": {"commit_proxies": len(p_proxies),
                                  "grv_proxies": len(p_grv),
                                  "storage": n_storage,
+                                 "replicas": n_replicas,
                                  "client_procs": n_client_procs,
                                  "merged_core": n_proxies == 0}}
     if backend != "oracle" and os.environ.get("FDBTPU_E2E_FORCE_CPU"):
@@ -525,7 +610,7 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
     try:
         # preload with an in-process client
         loop = RealEventLoop()
-        client, db = _make_db(loop, p_proxies, boundaries, p_storages,
+        client, db = _make_db(loop, p_proxies, boundaries, teams,
                               grv_proxies=p_grv)
 
         async def preload():
@@ -554,15 +639,17 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
 
         per = [clients // n_client_procs] * n_client_procs
         per[0] += clients - sum(per)
+        prev_store = _storage_counters(p_storages)
         for kind in phases:
-            srv_cpu0 = sum(_cpu_seconds(p.pid) for p in procs)
+            cpu0 = [_cpu_seconds(p.pid) for p in procs]
+            srv_cpu0 = sum(cpu0)
             workers = []
             for k in range(n_client_procs):
                 spec = {"kind": kind, "clients": per[k],
                         "seconds": seconds, "proxies": p_proxies,
                         "grv_proxies": p_grv,
                         "boundaries": [b.hex() for b in boundaries],
-                        "storages": p_storages}
+                        "teams": teams}
                 workers.append(subprocess.Popen(
                     [sys.executable, _SELF, "--worker", json.dumps(spec)],
                     stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -579,7 +666,8 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
             # server CPU sampled while the server procs are still alive;
             # the workers self-reported theirs in the result line (they may
             # already have exited by now)
-            srv_cpu1 = sum(_cpu_seconds(p.pid) for p in procs)
+            cpu1 = [_cpu_seconds(p.pid) for p in procs]
+            srv_cpu1 = sum(cpu1)
             for w in workers:
                 w.wait(timeout=60)
             rate = sum(r["ops"] for r in results) / seconds
@@ -587,6 +675,44 @@ def run(clients: int = 1500, seconds: float = 5.0, backend: str = "oracle",
             entry["cpu_split"] = {
                 "server_s": round(srv_cpu1 - srv_cpu0, 2),
                 "client_s": round(sum(r.get("cpu", 0.0) for r in results), 2)}
+            # per-process server CPU: the flat-per-replica-split evidence
+            entry["cpu_split"]["by_proc"] = {
+                lbl: round(c1 - c0, 2)
+                for lbl, c0, c1 in zip(labels, cpu0, cpu1)}
+            # replica balancer ledger, summed across client workers
+            lb_tot: dict[str, int] = {}
+            for r in results:
+                for name, cnt in (r.get("lb") or {}).items():
+                    if isinstance(cnt, (int, float)) and name in (
+                            "hedges", "hedge_wins", "failovers", "fallbacks"):
+                        lb_tot[name] = lb_tot.get(name, 0) + cnt
+            if lb_tot:
+                entry["client_lb"] = lb_tot
+            # storage-side ledger for this phase: per-replica read load and
+            # the read-cache hit/miss/invalidation counters, as DELTAS over
+            # the phase window (the counters are cumulative per process)
+            cur_store = _storage_counters(p_storages)
+            reads_by, cache_tot = {}, {}
+            for i, a in enumerate(p_storages):
+                d = {k: cur_store[a].get(k, 0) - prev_store.get(a, {}).get(k, 0)
+                     for k in ("PointReads", "BatchReadKeys", "ReadCacheHits",
+                               "ReadCacheMisses", "ReadCacheInvalidations",
+                               "WatermarkRejects")}
+                reads_by[labels[len(procs) - len(p_storages) + i]] = (
+                    d["PointReads"] + d["BatchReadKeys"])
+                for k, v in d.items():
+                    cache_tot[k] = cache_tot.get(k, 0) + v
+            prev_store = cur_store
+            entry["storage_reads_by_proc"] = reads_by
+            hot_seen = cache_tot["ReadCacheHits"] + cache_tot["ReadCacheMisses"]
+            entry["read_cache"] = {
+                "hits": cache_tot["ReadCacheHits"],
+                "misses": cache_tot["ReadCacheMisses"],
+                "invalidations": cache_tot["ReadCacheInvalidations"],
+                "hot_range_hit_rate": round(
+                    cache_tot["ReadCacheHits"] / hot_seen, 4) if hot_seen
+                else None}
+            entry["watermark_rejects"] = cache_tot["WatermarkRejects"]
             if kind in BASELINES:
                 entry["vs_baseline"] = round(rate / BASELINES[kind], 3)
             errs: dict[str, int] = {}
@@ -847,6 +973,49 @@ def run_native_transport(clients: int = 1000, seconds: float = 5.0) -> dict:
     return out
 
 
+def interleaved_medians(variants, phase: str = "read",
+                        trials: int = 3) -> dict:
+    """The shared trial machinery behind every ablation row pair: run the
+    variants INTERLEAVED `trials` times (A, B, ..., A, B, ...) and report
+    each variant's MEDIAN run by the phase's ops/s, with the per-trial
+    numbers kept in the row under "trials".
+
+    The bench host is a shared single-core VM whose available cycles drift
+    by tens of percent on a minutes scale, so back-to-back single runs
+    regularly invert a real ordering. Interleaving exposes every variant
+    to the same drift window; the median then rejects the one-sided
+    outliers the drift still produces.
+
+    `variants` is a list of (label, thunk) where thunk() returns one
+    `run()` report containing `phase`."""
+    runs: dict[str, list] = {label: [] for label, _ in variants}
+    for _ in range(trials):
+        for label, thunk in variants:
+            runs[label].append(thunk())
+    out: dict = {}
+    for label, reports in runs.items():
+        reports.sort(key=lambda rep: rep[phase]["ops_per_sec"])
+        median = reports[len(reports) // 2]
+        median[phase]["trials"] = [rep[phase]["ops_per_sec"]
+                                   for rep in reports]
+        out[label] = median
+    return out
+
+
+def _env_run(env: dict[str, str], **kw):
+    """One run() with env vars pinned for its duration (not just knobs:
+    server processes AND client workers inherit os.environ, and the env
+    override wins on both sides)."""
+    def thunk():
+        os.environ.update(env)
+        try:
+            return run(**kw)
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+    return thunk
+
+
 def run_native_client(clients: int = 1000, seconds: float = 5.0,
                       trials: int = 3) -> dict:
     """The native-client-plane rows for BENCH_r15: the standing r10-shaped
@@ -855,41 +1024,93 @@ def run_native_client(clients: int = 1000, seconds: float = 5.0,
     the client half off — so the delta isolates exactly what PR 19 added
     over the r14 configuration. trace=True for the stage breakdown and
     the transport counter rollup (ClientNativeSettles must show the
-    replies actually settled through the C pump).
+    replies actually settled through the C pump). Interleaved medians
+    (see interleaved_medians)."""
+    kw = dict(clients=clients, seconds=seconds, backend="oracle",
+              n_proxies=0, n_storage=1, phases=("read",), trace=True)
+    return interleaved_medians([
+        ("e2e_read_native_client",
+         _env_run({"NET_NATIVE_TRANSPORT": "1", "NET_NATIVE_CLIENT": "1"},
+                  extra_knobs={"NET_NATIVE_TRANSPORT": 1,
+                               "NET_NATIVE_CLIENT": 1}, **kw)),
+        ("e2e_read_python_client",
+         _env_run({"NET_NATIVE_TRANSPORT": "1", "NET_NATIVE_CLIENT": "0"},
+                  extra_knobs={"NET_NATIVE_TRANSPORT": 1,
+                               "NET_NATIVE_CLIENT": 0}, **kw)),
+    ], phase="read", trials=trials)
 
-    The rows are the per-row MEDIAN of `trials` INTERLEAVED runs
-    (native, ablation, native, ablation, ...): the bench host is a shared
-    single-core VM whose available cycles drift by tens of percent on a
-    minutes scale, so back-to-back single runs regularly invert a real
-    ordering. Interleaving exposes both rows to the same drift; the
-    per-trial ops/s are kept in the row under "trials"."""
-    runs: dict[str, list] = {"e2e_read_native_client": [],
-                             "e2e_read_python_client": []}
-    for _ in range(trials):
-        for label, on in (("e2e_read_native_client", "1"),
-                          ("e2e_read_python_client", "0")):
-            # env vars (not just knobs): server processes AND client
-            # workers inherit os.environ, and the env override wins on
-            # both sides
-            os.environ["NET_NATIVE_TRANSPORT"] = "1"
-            os.environ["NET_NATIVE_CLIENT"] = on
-            try:
-                runs[label].append(run(
-                    clients=clients, seconds=seconds, backend="oracle",
-                    n_proxies=0, n_storage=1, phases=("read",), trace=True,
-                    extra_knobs={"NET_NATIVE_TRANSPORT": 1,
-                                 "NET_NATIVE_CLIENT": int(on)}))
-            finally:
-                os.environ.pop("NET_NATIVE_TRANSPORT", None)
-                os.environ.pop("NET_NATIVE_CLIENT", None)
-    out: dict = {}
-    for label, reports in runs.items():
-        reports.sort(key=lambda rep: rep["read"]["ops_per_sec"])
-        median = reports[len(reports) // 2]
-        median["read"]["trials"] = [rep["read"]["ops_per_sec"]
-                                    for rep in reports]
-        out[label] = median
+
+def run_read_scaling(clients: int = 1000, seconds: float = 5.0,
+                     trials: int = 3) -> dict:
+    """The read scale-out rows for BENCH_r16: the standing e2e read row at
+    1, 2, and 3 storage replicas of the same single shard, all replicas
+    serving reads behind the client's EWMA + hedged-backup balancer — a
+    same-run interleaved ablation (replica count is the ONLY difference
+    between the rows), plus the n_grv_proxies 0-vs-2 pair on the 2-replica
+    topology showing the horizontal GRV path paying.
+
+    Honesty note, recorded with the rows: the bench host has ONE core.
+    Replicas cannot add cycles here — every added process divides the same
+    core further — so this host measures the protocol overhead/balance of
+    the fan-out (per-replica load split, hedge/failover ledger), not the
+    multi-core speedup the topology exists for. The scaling claim on this
+    host is judged by the per-replica read split being flat while
+    correctness counters stay clean."""
+    scaling = interleaved_medians([
+        (f"replicas_{r}",
+         _env_run({}, clients=clients, seconds=seconds, backend="oracle",
+                  n_proxies=0, n_storage=1, n_replicas=r, phases=("read",)))
+        for r in (1, 2, 3)
+    ], phase="read", trials=trials)
+    grv = interleaved_medians([
+        (f"grv_proxies_{g}",
+         _env_run({}, clients=clients, seconds=seconds, backend="oracle",
+                  n_proxies=0, n_storage=1, n_replicas=2,
+                  n_grv_proxies=g, phases=("read",)))
+        for g in (0, 2)
+    ], phase="read", trials=trials)
+    out = dict(scaling)
+    out["grv_fanout"] = grv
+    base = scaling["replicas_1"]["read"]["ops_per_sec"]
+    out["scaling_vs_1_replica"] = {
+        f"replicas_{r}": round(
+            scaling[f"replicas_{r}"]["read"]["ops_per_sec"] / base, 3)
+        for r in (2, 3)}
+    out["host_note"] = (
+        "single-core bench host: replicas divide one core, so the judged "
+        "signal is the flat per-replica read split + clean ledgers, not "
+        "multi-core speedup")
     return out
+
+
+def run_zipfian_hotspot(clients: int = 1000, seconds: float = 5.0,
+                        trials: int = 3) -> dict:
+    """The zipfian read-hotspot rows for BENCH_r16: the zipfian-read phase
+    (80% of reads drawn zipfian over a 64-key hot prefix) on the 2-replica
+    topology with the versioned storage read cache ON vs OFF — interleaved
+    medians, with the cache ledger (hits/misses/invalidations, per-replica
+    read split) folded into each row from the storage counters. Runs on
+    the Python serve path (native data plane off — the default here), so
+    the cache actually fields the reads; the acceptance bar is the hot-
+    range hit rate, checked against the hits/misses ledger."""
+    kw = dict(clients=clients, seconds=seconds, backend="oracle",
+              n_proxies=0, n_storage=1, n_replicas=2,
+              phases=("zipfian-read",))
+    out = interleaved_medians([
+        ("zipfian_cache_on", _env_run({}, **kw)),
+        ("zipfian_cache_off",
+         _env_run({}, extra_knobs={"READ_CACHE_ENABLED": False}, **kw)),
+    ], phase="zipfian-read", trials=trials)
+    cache = out["zipfian_cache_on"]["zipfian-read"].get("read_cache") or {}
+    out["hot_range_hit_rate"] = cache.get("hot_range_hit_rate")
+    return out
+
+
+def run_r16(clients: int = 1000, seconds: float = 5.0,
+            trials: int = 3) -> dict:
+    """The full BENCH_r16 report: read scaling + zipfian hotspot."""
+    return {"read_scaling": run_read_scaling(clients, seconds, trials),
+            "zipfian_hotspot": run_zipfian_hotspot(clients, seconds, trials)}
 
 
 if __name__ == "__main__":
@@ -910,6 +1131,15 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--native-client" in sys.argv:
         print(json.dumps(run_native_client(), indent=2))
+        sys.exit(0)
+    if "--read-scaling" in sys.argv:
+        print(json.dumps(run_read_scaling(), indent=2))
+        sys.exit(0)
+    if "--zipfian-hotspot" in sys.argv:
+        print(json.dumps(run_zipfian_hotspot(), indent=2))
+        sys.exit(0)
+    if "--r16" in sys.argv:
+        print(json.dumps(run_r16(), indent=2))
         sys.exit(0)
     backends = [a for a in sys.argv[1:] if not a.startswith("--")] or ["oracle"]
     out = {b: run(backend=b) for b in backends}

@@ -87,15 +87,18 @@ _FAILOVER_ERRORS = ("broken_promise", "request_maybe_delivered")
 
 
 class ReplicaStats:
-    """Per-replica smoothed request latency (the QueueModel backing
-    loadBalance, fdbrpc/QueueModel.h): one EWMA per address, fed by every
-    completed read. Unknown replicas report the team's best known latency so
-    a fresh replica gets probed instead of starved."""
+    """Per-replica smoothed request latency plus outstanding depth (the
+    QueueModel backing loadBalance, fdbrpc/QueueModel.h): one EWMA per
+    address, fed by every completed read, and a client-side count of this
+    handle's in-flight requests per replica. Unknown replicas report the
+    team's best known latency so a fresh replica gets probed instead of
+    starved."""
 
-    __slots__ = ("ewma",)
+    __slots__ = ("ewma", "inflight")
 
     def __init__(self):
         self.ewma: dict[str, float] = {}
+        self.inflight: dict[str, int] = {}
 
     def record(self, addr: str, latency: float):
         prev = self.ewma.get(addr)
@@ -103,21 +106,35 @@ class ReplicaStats:
         self.ewma[addr] = latency if prev is None \
             else prev + alpha * (latency - prev)
 
+    def begin(self, addr: str):
+        self.inflight[addr] = self.inflight.get(addr, 0) + 1
+
+    def end(self, addr: str):
+        n = self.inflight.get(addr, 0) - 1
+        if n > 0:
+            self.inflight[addr] = n
+        else:
+            self.inflight.pop(addr, None)
+
     def expected(self, addr: str, default: float) -> float:
         return self.ewma.get(addr, default)
 
     def order(self, team: list[str], rng) -> list[str]:
         """Team sorted fastest-first. Unknown replicas inherit the best
-        known EWMA, and every estimate gets a small multiplicative jitter —
+        known EWMA, every estimate gets a small multiplicative jitter —
         near-equal replicas keep swapping places (so load spreads and the
         model keeps sampling everyone), while a genuinely slow replica
-        stays last."""
+        stays last — and queued depth multiplies the estimate (QueueModel's
+        outstanding penalty: a replica already holding this client's
+        batches costs its latency times the queue it must drain first)."""
         if len(team) <= 1:
             return list(team)
         known = [v for a in team if (v := self.ewma.get(a)) is not None]
         default = min(known) if known else 0.0
+        inflight = self.inflight
         return sorted(team, key=lambda a: self.expected(a, default)
-                      * (0.8 + 0.4 * rng.random()))
+                      * (0.8 + 0.4 * rng.random())
+                      * (1.0 + inflight.get(a, 0)))
 
 
 def _relay_list(subs: list[Future], f: Future):
@@ -170,6 +187,11 @@ class Database:
         self._read_batch_max = KNOBS.READ_BATCH_MAX
         # per-replica latency model driving read load balance + hedging
         self._replica_stats = ReplicaStats()
+        # read load-balance telemetry, folded into metrics snapshots via
+        # lb_snapshot(): backup requests launched/won, replica failovers,
+        # and per-entry fallback re-resolutions across this handle
+        self.lb_counters = {"hedges": 0, "hedge_wins": 0, "failovers": 0,
+                            "fallbacks": 0}
         # client-side span idents (NativeAPI debugTransaction): one sequence
         # per database, address-prefixed so traces from many client processes
         # merge without collisions
@@ -347,7 +369,7 @@ class Database:
         try:
             inner = self.process.net.request(
                 self.process, self._pick_proxy(Token.PROXY_GET_READ_VERSION),
-                GetReadVersionRequest(debug_id=span_id))
+                GetReadVersionRequest(debug_id=span_id, count=len(waiters)))
         except FDBError as e:
             settle(None, FDBError(e.name, e.detail))
             return
@@ -372,6 +394,16 @@ class Database:
             if not self.coordinators:
                 raise FDBError("cluster_not_fully_recovered", "no layout known")
             await self.refresh()
+
+    def lb_snapshot(self) -> dict:
+        """Load-balance telemetry for metrics snapshots: the hedge/failover
+        tallies plus the per-replica latency model and outstanding depth."""
+        snap = dict(self.lb_counters)
+        snap["replica_ewma_ms"] = {
+            a: round(v * 1000.0, 3)
+            for a, v in sorted(self._replica_stats.ewma.items())}
+        snap["replica_inflight"] = dict(self._replica_stats.inflight)
+        return snap
 
     def _team_order(self, team: list[str]) -> list[str]:
         """Load balance: replicas ordered by smoothed latency (EWMA), the
@@ -456,6 +488,7 @@ class Database:
                     # latency observation (it may never settle in-window),
                     # so the model stops preferring it; then hedge
                     stats.record(addr0, self.loop.now() - start0)
+                    self.lb_counters["hedges"] += 1
                     launch = True
                     continue
                 pos = next(i for i, (_a, _s, f) in enumerate(inflight)
@@ -463,15 +496,28 @@ class Database:
                 addr, start, _f = inflight.pop(pos)
                 if not winner.is_error():
                     stats.record(addr, self.loop.now() - start)
+                    if pos > 0:  # a younger duplicate beat the original
+                        self.lb_counters["hedge_wins"] += 1
                     return winner.get()
                 e = winner._result
-                if not isinstance(e, FDBError) or e.name in (
-                        "operation_cancelled", "wrong_shard_server"):
+                if not isinstance(e, FDBError) \
+                        or e.name == "operation_cancelled":
                     raise e
                 # a failed attempt reads as slow so ordering learns from it
                 stats.record(addr, self._backup_delay(addr))
                 last = e
+                if e.name == "wrong_shard_server" \
+                        and (inflight or idx < len(order)):
+                    # replica-LOCAL rejection first (a fetched-version
+                    # watermark or revocation fence on one copy): another
+                    # replica may hold the history, so the shard has only
+                    # truly moved when every replica says so — then the
+                    # exhausted raise below sends the caller to re-resolve
+                    self.lb_counters["failovers"] += 1
+                    launch = not inflight
+                    continue
                 if e.name in _FAILOVER_ERRORS:
+                    self.lb_counters["failovers"] += 1
                     launch = not inflight  # replica down: move on
                     continue
                 raise e
@@ -583,6 +629,7 @@ class Database:
         """Per-entry path for a read that fell out of a batch: re-resolves
         the location cache and fails over on its own. `k` is a single key
         (bytes) or a multiget's key tuple."""
+        self.lb_counters["fallbacks"] += 1
         if type(k) is bytes:
             inner = self.loop.spawn(self._storage_request(
                 k, Token.STORAGE_GET_VALUE,
@@ -643,10 +690,12 @@ class Database:
             stats = self._replica_stats
             span_id = self._next_span_id("read")
             t0 = self.loop.now()
+            stats.begin(addr)
             inner = self.process.net.request(
                 self.process, Endpoint(addr, Token.STORAGE_GET_VALUES), req)
 
             def on_reply(s: Future):
+                stats.end(addr)
                 g_trace_batch.span_begin("CommitSpan", span_id,
                                          "Client.Read", at=t0)
                 g_trace_batch.span_end("CommitSpan", span_id, "Client.Read",
@@ -673,22 +722,118 @@ class Database:
 
             inner.add_callback(on_reply)
             return
-        try:
-            rep = await self._on_team(
-                team, lambda addr: self.process.net.request(
-                    self.process, Endpoint(addr, Token.STORAGE_GET_VALUES),
-                    req))
-        except FDBError as e:
-            if e.name == "operation_cancelled":
-                raise
-            # whole-batch failure (team down, future_version, stale shard)
-            if e.name == "wrong_shard_server" and self.coordinators:
+        self._send_read_group_hedged(order, req, ents, flat)
+
+    def _send_read_group_hedged(self, order: list[str], req, ents,
+                                flat: bool) -> None:
+        """Multi-replica batched read, collapsed to reply callbacks like
+        the single-replica fast path but multiplexed across the team: send
+        to the EWMA-best replica, arm a backup-request timer off its
+        expected latency, and let the first successful reply settle the
+        whole batch in its own loop tick (LoadBalance.actor.h:159's backup
+        request without the per-batch coroutine — what finally wires PR 2's
+        hedging to the batched multi-replica read path). Replica-LOCAL
+        rejections (down replica, fetched-version watermark) fail over to
+        the next replica; the batch falls back to per-entry re-resolution
+        only when the team is exhausted or the error is not replica-local."""
+        stats = self._replica_stats
+        counters = self.lb_counters
+        state = {"idx": 0, "pending": 0, "done": False}
+        span_id = self._next_span_id("read")
+        t00 = self.loop.now()
+
+        def settle_done():
+            state["done"] = True
+            g_trace_batch.span_begin("CommitSpan", span_id, "Client.Read",
+                                     at=t00)
+            g_trace_batch.span_end("CommitSpan", span_id, "Client.Read",
+                                   at=self.loop.now())
+
+        def fallback_all(invalidate: bool):
+            settle_done()
+            if invalidate and self.coordinators:
                 self.locations.invalidate()
             for k, v, f in ents:
                 if not f.is_ready():
                     self._read_fallback(k, v, f)
-            return
-        self._distribute_read_results(ents, rep.results, flat)
+
+        def launch():
+            if state["done"] or state["idx"] >= len(order):
+                return
+            addr = order[state["idx"]]
+            state["idx"] += 1
+            was_hedge = state["pending"] > 0
+            t0 = self.loop.now()
+            settled = [False]
+            stats.begin(addr)
+            state["pending"] += 1
+            try:
+                inner = self.process.net.request(
+                    self.process, Endpoint(addr, Token.STORAGE_GET_VALUES),
+                    req)
+            except Exception as e:  # noqa: BLE001 — relay like a reply error
+                settled[0] = True
+                stats.end(addr)
+                state["pending"] -= 1
+                if not state["done"] and state["pending"] == 0:
+                    settle_done()
+                    for _k, _v, f in ents:
+                        if not f.is_ready():
+                            f._set_error(e)
+                return
+
+            def on_reply(s: Future):
+                settled[0] = True
+                stats.end(addr)
+                state["pending"] -= 1
+                if state["done"]:
+                    return
+                if not s.is_error():
+                    stats.record(addr, self.loop.now() - t0)
+                    if was_hedge:
+                        counters["hedge_wins"] += 1
+                    settle_done()
+                    self._distribute_read_results(ents, s._result.results,
+                                                  flat)
+                    return
+                e = s._result
+                if not isinstance(e, FDBError) \
+                        or e.name == "operation_cancelled":
+                    settle_done()
+                    for _k, _v, f in ents:
+                        if not f.is_ready():
+                            f._set_error(e)
+                    return
+                # a failed attempt reads as slow so ordering learns from it
+                stats.record(addr, self._backup_delay(addr))
+                replica_local = (e.name in _FAILOVER_ERRORS
+                                 or e.name == "wrong_shard_server")
+                if replica_local and (state["pending"] > 0
+                                      or state["idx"] < len(order)):
+                    counters["failovers"] += 1
+                    if state["pending"] == 0:
+                        launch()
+                    return
+                # team exhausted, or a whole-batch condition
+                # (future_version, transaction_too_old)
+                fallback_all(e.name == "wrong_shard_server")
+
+            inner.add_callback(on_reply)
+            if state["idx"] < len(order):
+                delay = self._backup_delay(addr)
+
+                def hedge():
+                    if state["done"] or settled[0]:
+                        return
+                    # the laggard's outstanding time IS a latency
+                    # observation, so the model stops preferring it
+                    stats.record(addr, self.loop.now() - t0)
+                    counters["hedges"] += 1
+                    launch()
+
+                self.loop._schedule(delay, 0, hedge)
+
+        launch()
 
     def _distribute_read_results(self, ents, results, flat: bool) -> None:
         """Fan one GetValuesReply back out to the batch's futures: parallel

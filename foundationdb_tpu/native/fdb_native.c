@@ -3746,8 +3746,18 @@ done:
 static int tp_serve_grv(TransportTable *t, uint64_t reply_id,
                         const uint8_t *body, Py_ssize_t blen, WBuf *out) {
     Py_ssize_t pos = 0;
-    int64_t priority = 0;
-    if (tp_request_head(body, blen, &pos, t->tid_grv_req, 2) < 0 ||
+    int64_t priority = 0, count = 1;
+    uint64_t tid = 0, nf = 0;
+    if (blen < 2 || body[0] != W_MAGIC || body[1] != W_VERSION)
+        return TP_FALL;
+    pos = 2;
+    /* count is trailing-defaulted on GetReadVersionRequest, so both the
+     * 2-field (older encoders) and 3-field forms are live on the wire */
+    if (tp_expect(body, blen, &pos, 'R') < 0 ||
+        tp_read_varint(body, blen, &pos, &tid) < 0 ||
+        tid != t->tid_grv_req ||
+        tp_read_varint(body, blen, &pos, &nf) < 0 ||
+        (nf != 2 && nf != 3) ||
         tp_expect(body, blen, &pos, 'i') < 0 ||
         tp_read_zigzag(body, blen, &pos, &priority) < 0 || pos >= blen)
         return TP_FALL;
@@ -3766,16 +3776,21 @@ static int tp_serve_grv(TransportTable *t, uint64_t reply_id,
     } else {
         return TP_FALL;
     }
+    if (nf == 3) {
+        if (tp_expect(body, blen, &pos, 'i') < 0 ||
+            tp_read_zigzag(body, blen, &pos, &count) < 0 || count < 1)
+            return TP_FALL;
+    }
     if (pos != blen)
         return TP_FALL;
-    if (priority != 0 || t->grv_allowance <= 0 || t->grv_version < 0)
+    if (priority != 0 || t->grv_allowance < count || t->grv_version < 0)
         return TP_FALL;
     WBuf w = {NULL, 0, 0};
     int64_t version = t->grv_version;
-    uint64_t tid = t->tid_grv_rep;
+    uint64_t rtid = t->tid_grv_rep;
     /* GetReadVersionReply { version: int } */
     if (wb_byte(&w, W_MAGIC) < 0 || wb_byte(&w, W_VERSION) < 0 ||
-        wb_byte(&w, 'R') < 0 || wb_varint(&w, tid) < 0 ||
+        wb_byte(&w, 'R') < 0 || wb_varint(&w, rtid) < 0 ||
         wb_varint(&w, 1) < 0 || wb_byte(&w, 'i') < 0 ||
         wb_zigzag(&w, version) < 0 ||
         tp_emit_frame(t, out, reply_id, TP_REPLY, w.buf, w.len) < 0) {
@@ -3783,8 +3798,11 @@ static int tp_serve_grv(TransportTable *t, uint64_t reply_id,
         return -1;
     }
     PyMem_Free(w.buf);
-    t->grv_allowance--;
-    t->hits_grv++;
+    /* spend the batched transaction count, not 1 per wire request, so the
+     * allowance and the hit counter line up with the Python path's
+     * ratekeeper token spend */
+    t->grv_allowance -= count;
+    t->hits_grv += (uint64_t)count;
     return TP_SERVED;
 }
 
@@ -4175,7 +4193,8 @@ static PyTypeObject TransportConnType = {
      GetKeyValuesRequest { begin: KeySelector, end: KeySelector,
                            version: int, limit: int, limit_bytes: int,
                            reverse: bool }
-     GetReadVersionRequest { priority: int, debug_id: str|None }
+     GetReadVersionRequest { priority: int, debug_id: str|None,
+                             count: int }
 */
 /* ------------------------------------------------------------------ */
 

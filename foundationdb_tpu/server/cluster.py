@@ -21,6 +21,7 @@ from foundationdb_tpu.server.proxy import Proxy, ResolverMap, ShardMap
 from foundationdb_tpu.server.resolver import Resolver
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.utils.knobs import KNOBS
 from foundationdb_tpu.utils.rng import DeterministicRandom
 
 
@@ -159,7 +160,8 @@ class RecoverableCluster:
 
     def __init__(self, seed: int = 0, n_coordinators: int = 3,
                  n_workers: int = 5, n_proxies: int = 2, n_resolvers: int = 1,
-                 n_tlogs: int = 2, n_storage: int = 2, n_replicas: int = 1,
+                 n_tlogs: int = 2, n_storage: int = 2,
+                 n_replicas: int | None = None,
                  n_storage_workers: int | None = None,
                  region_dcs: tuple | None = None,
                  satellite_dc: str | None = None, n_satellites: int = 0,
@@ -173,6 +175,8 @@ class RecoverableCluster:
         from foundationdb_tpu.server.coordination import Coordinator, elect_leader
         from foundationdb_tpu.server.worker import Worker
 
+        if n_replicas is None:
+            n_replicas = KNOBS.READ_REPLICAS
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
         self.net = SimNetwork(self.loop, self.rng.fork())

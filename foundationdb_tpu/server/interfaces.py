@@ -136,6 +136,13 @@ class GetReadVersionRequest:
 
     priority: int = 0
     debug_id: str | None = None  # client span id (trailing: wire-compatible)
+    # how many client transactions this (batched) request stands for: the
+    # client's GRV batcher coalesces N concurrent waiters into ONE wire
+    # request, and the proxy both spends N ratekeeper tokens and counts N
+    # GRVs served — the reference's transactionCount on
+    # GetReadVersionRequest. Trailing-defaulted: wire-compatible with
+    # older encoders (decoders fill 1).
+    count: int = 1
 
 
 @dataclass

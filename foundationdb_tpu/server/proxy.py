@@ -335,7 +335,7 @@ class Proxy:
                 self.process.net._native_grv_owner = None
         self._master_last_seen = float("-inf")  # fence immediately
         queued, self._grv_queue = self._grv_queue, deque()
-        for reply in queued:  # don't strand throttled waiters until timeout
+        for reply, _n in queued:  # don't strand throttled waiters until timeout
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy shut down"))
 
@@ -346,7 +346,7 @@ class Proxy:
         from foundationdb_tpu.utils.stats import fold_transport_counters
         snap = self.counters.as_dict()
         snap["CommittedVersion"] = self.committed_version.get()
-        snap["GRVQueueDepth"] = len(self._grv_queue)
+        snap["GRVQueueDepth"] = sum(n for _r, n in self._grv_queue)
         reply.send(fold_transport_counters(self.process, snap))
 
     def _shards_from_txn_state(self) -> ShardMap:
@@ -475,6 +475,10 @@ class Proxy:
         delta = hits - self._native_grv_hits
         self._native_grv_hits = hits
         if delta:
+            # the C plane spends the request's batched count field, so
+            # NativeGRVHits counts TRANSACTIONS (not wire flushes) and the
+            # delta folds 1:1 against the same token bucket the Python
+            # path draws from.
             self._c_grv_in.increment(delta)
             if self._rk_tps is not None:
                 self._grv_tokens = max(0.0, self._grv_tokens - delta)
@@ -552,8 +556,8 @@ class Proxy:
                                        + self._rk_tps * interval, burst)
             self._native_grv_refresh()
             while self._grv_queue and self._grv_tokens >= 1.0:
-                self._grv_tokens -= 1.0
-                reply = self._grv_queue.popleft()
+                reply, n = self._grv_queue.popleft()
+                self._grv_tokens -= n  # may overdraw; refill repays at tps
                 # the lease can expire while a request waits in line; serving
                 # it anyway would hand out a deposed generation's stale
                 # committed version past the recovery grace period
@@ -599,14 +603,23 @@ class Proxy:
             reply.send_error(FDBError("cluster_not_fully_recovered",
                                       "proxy lost its master"))
             return
-        self._c_grv_in.increment()
+        # batched fan-in: the client's GRV batcher coalesces N transactions
+        # into one wire request carrying count=N (the reference's
+        # transactionCount), so the ratekeeper budget is spent in
+        # TRANSACTIONS — one flush of 20 waiters costs 20 tokens, not 1 —
+        # while the peer confirm rounds downstream stay O(rounds)
+        n = max(1, int(getattr(req, "count", 1) or 1))
+        self._c_grv_in.increment(n)
         if self._rk_tps is not None:
-            # ratekeeper-gated: spend a token or wait in line
+            # ratekeeper-gated: spend tokens or wait in line. Admission is
+            # head-of-line at >= 1 token with the spend allowed to overdraw
+            # (the pump refills at tps), so a flush larger than the burst
+            # can never starve behind it.
             if not self._grv_queue and self._grv_tokens >= 1.0:
-                self._grv_tokens -= 1.0
+                self._grv_tokens -= n
                 self._serve_grv(reply)
             else:
-                self._grv_queue.append(reply)
+                self._grv_queue.append((reply, n))
             return
         self._serve_grv(reply)
 

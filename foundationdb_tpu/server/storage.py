@@ -35,6 +35,9 @@ from foundationdb_tpu.utils import wire
 _DURABLE_VERSION_KEY = "durableVersion"
 _KS_PREFIX = b"\xff/keyServers/"
 _SSD_DIR: list[str] = []
+# watermark sentinel: a rollback unwound a fetchKeys splice, so the range
+# has NO valid local history at any version until a new splice re-copies it
+_WM_INFINITE = 1 << 62
 
 
 def _default_ssd_dir() -> str:
@@ -88,6 +91,18 @@ class StorageServer:
         # still lists the range (the authoritative SET_SHARDS push is a
         # racing one-way message; the version stream is not)
         self._revoked: list[tuple[bytes, bytes | None, int]] = []
+        # fetched-version LOW watermarks: (begin, end, version) means this
+        # server's history for [begin, end) starts at `version` (a fetchKeys
+        # splice copied the range's state AT that version; the MVCC window
+        # below it holds pre-splice state — empty, or stale from before the
+        # range moved away). Reads BELOW the watermark get
+        # wrong_shard_server so the client re-resolves onto a replica that
+        # has the history — the low-fence mirror of _revoked's upper fences,
+        # and what makes a freshly-topped-up replica never weaker than
+        # single-copy. Narrowed by a re-splice (which re-copies the range),
+        # pruned once durability passes them (transaction_too_old covers),
+        # and raised to _WM_INFINITE by a rollback that unwinds the splice.
+        self._watermarks: list[tuple[bytes, bytes | None, int]] = []
         # engine selection (openKVStore dispatch IKeyValueStore.h:66,
         # KeyValueStoreType FDBTypes.h:475): "memory" = hashmap + sim-file
         # WAL (kill-injected durability faults); "redwood" = log-structured
@@ -201,6 +216,16 @@ class StorageServer:
             "batch_gets": self.counters.counter("EngineBatchReads"),
         }
         self._engine_stats_seen: dict[str, int] = {}
+        # versioned hot-key read cache (readcache.py): zipfian skew is
+        # answered from one dict probe per key; the update loop invalidates
+        # touched entries in the same tick it applies their mutations
+        from foundationdb_tpu.server.readcache import VersionedReadCache
+        self._read_cache = (VersionedReadCache()
+                            if KNOBS.READ_CACHE_ENABLED else None)
+        self._c_cache_hits = self.counters.counter("ReadCacheHits")
+        self._c_cache_misses = self.counters.counter("ReadCacheMisses")
+        self._c_cache_inval = self.counters.counter("ReadCacheInvalidations")
+        self._c_wm_rejects = self.counters.counter("WatermarkRejects")
         process.register(Token.STORAGE_METRICS, self._on_metrics)
         self._counters_task = trace_counters_loop(process, self.counters)
         self._ingest_gate: object | None = None  # set while fetchKeys runs
@@ -259,7 +284,8 @@ class StorageServer:
             return
         store = getattr(self.data, "_store", None)  # the C VStore, if native
         eligible = (store is not None and self.shard_ranges is None
-                    and not self._revoked and not self._native_plane_blocked)
+                    and not self._revoked and not self._watermarks
+                    and not self._native_plane_blocked)
         if not eligible:
             if self._native_plane:
                 self._native_plane = False
@@ -299,13 +325,32 @@ class StorageServer:
                 c.increment(delta)
             self._engine_stats_seen[name] = total
 
+    def _sync_cache_counters(self):
+        """Fold the read cache's running tallies into the CounterCollection
+        as deltas (same monotone-fold discipline as the engine counters)."""
+        rc = self._read_cache
+        if rc is None:
+            return
+        for c, attr in ((self._c_cache_hits, "hits"),
+                        (self._c_cache_misses, "misses"),
+                        (self._c_cache_inval, "invalidations")):
+            total = getattr(rc, attr)
+            seen = self._engine_stats_seen.get("cache_" + attr, 0)
+            if total > seen:
+                c.increment(total - seen)
+            self._engine_stats_seen["cache_" + attr] = total
+
     def _on_metrics(self, req, reply):
         from foundationdb_tpu.utils.stats import fold_transport_counters
         self._sync_engine_counters()
+        self._sync_cache_counters()
         snap = self.counters.as_dict()
         snap["Version"] = self.version.get()
         snap["DurableVersion"] = self.durable_version
         snap["LagVersions"] = self.version.get() - self.durable_version
+        if self._read_cache is not None:
+            snap["ReadCacheEntries"] = len(self._read_cache.entries)
+            snap["ReadCacheHotRanges"] = len(self._read_cache.hot_ranges)
         reply.send(fold_transport_counters(self.process, snap))
 
     # -- recovery (rollback :2211 + log-system rebind) --
@@ -343,6 +388,18 @@ class StorageServer:
             return
         rollback_to = req.rollback_to
         self.data.rollback(rollback_to)
+        if self._read_cache is not None:
+            self._read_cache.clear()  # tags above rollback_to are now lies
+        # a splice ABOVE the rollback point was unwound with it: the range's
+        # copied-in state is gone from the MVCC map and the splice is not in
+        # any log, so no version of it is locally readable until the
+        # distributor re-fetches (its move reply failed with the recovery,
+        # so it will). Raise the watermark to the sentinel; a new _add_shard
+        # splice narrows it back out.
+        if self._watermarks:
+            self._watermarks = [
+                (b, e, v if v <= rollback_to else _WM_INFINITE)
+                for b, e, v in self._watermarks]
         self._native_plane_update()
         while self._pending_durable and self._pending_durable[-1][0] > rollback_to:
             self._pending_durable.pop()
@@ -533,6 +590,27 @@ class StorageServer:
                     if req.end is not None and (e is None or req.end < e):
                         kept.append((req.end, e, v))
                 self._revoked = kept
+            # record the fetched-version watermark: this range's local
+            # history starts at c0. Older overlapping watermarks are
+            # narrowed the same way as revocations (the re-copy supersedes
+            # them exactly over [begin, end)) before the new one lands.
+            if self._watermarks:
+                kept_wm: list[tuple[bytes, bytes | None, int]] = []
+                for b, e, v in self._watermarks:
+                    if ((e is not None and e <= req.begin)
+                            or (req.end is not None and b >= req.end)):
+                        kept_wm.append((b, e, v))
+                        continue
+                    if b < req.begin:
+                        kept_wm.append((b, req.begin, v))
+                    if req.end is not None and (e is None or req.end < e):
+                        kept_wm.append((req.end, e, v))
+                self._watermarks = kept_wm
+            self._watermarks.append((req.begin, req.end, c0))
+            if self._read_cache is not None:
+                # the splice wrote history outside the update loop's
+                # invalidation pass; tags can no longer prove exactness
+                self._read_cache.clear()
             reply.send(c0)
         except FDBError as e:
             reply.send_error(e)
@@ -582,6 +660,11 @@ class StorageServer:
                     self.data.apply(version, m)
                     if m.param1 >= _KS_PREFIX:
                         self._apply_shard_private(m, version)
+                rc = self._read_cache
+                if rc is not None and rc.entries:
+                    # same tick as apply: an entry that survives has
+                    # provably seen no mutation since its version tag
+                    rc.invalidate(muts)
                 self._c_mutations.increment(len(muts))
                 self._pending_durable.append((version, muts))
                 self._peek_begin = version
@@ -662,6 +745,11 @@ class StorageServer:
         finally:
             self._commit_inflight = False
         self.data.forget_before(target)
+        # watermarks at/below the MVCC floor can never fire again — any
+        # version they would reject already throws transaction_too_old
+        if self._watermarks:
+            self._watermarks = [(b, e, v) for b, e, v in self._watermarks
+                                if v > self.data.oldest_version]
         self._native_plane_update()  # oldest bound moved: push before serving
         popped: set[tuple[str, str]] = set()
         for epoch in self.log_epochs:
@@ -773,6 +861,19 @@ class StorageServer:
                 # until the layout push lands
                 self._revoked.append((max(b, point), e, version))
 
+    def _below_watermark(self, begin: bytes, end: bytes | None,
+                         version: int) -> bool:
+        """True when [begin, end) overlaps a range whose local history
+        starts ABOVE `version` — the read must get wrong_shard_server so
+        the client re-resolves onto a replica that has the history (this
+        server's pre-splice state for the range is empty or stale)."""
+        for b, e, v in self._watermarks:
+            if (version < v and (e is None or begin < e)
+                    and (end is None or b < end)):
+                self._c_wm_rejects.increment()
+                return True
+        return False
+
     def _revoked_read(self, begin: bytes, end: bytes | None,
                       version: int) -> bool:
         """True when [begin, end) overlaps a range revoked at/below
@@ -829,10 +930,34 @@ class StorageServer:
             if self._revoked and self._revoked_read(
                     req.key, req.key + b"\x00", req.version):
                 raise FDBError("wrong_shard_server")
-            reply.send(GetValueReply(value=self.data.get(req.key, req.version),
-                                     version=req.version))
+            if self._watermarks and self._below_watermark(
+                    req.key, req.key + b"\x00", req.version):
+                raise FDBError("wrong_shard_server")
+            rc = self._read_cache
+            if rc is not None:
+                rc.note_reads(req.key, 1, self.process.net.loop.now())
+                hit, value = rc.lookup(req.key, req.version)
+                if hit:
+                    reply.send(GetValueReply(value=value,
+                                             version=req.version))
+                    return
+            value = self.data.get(req.key, req.version)
+            if rc is not None and rc.hot_ranges and rc.is_hot(req.key):
+                self._cache_populate(rc, req.key, value, req.version)
+            reply.send(GetValueReply(value=value, version=req.version))
         except FDBError as e:
             reply.send_error(e)
+
+    def _cache_populate(self, rc, key: bytes, value, read_version: int):
+        """Tag with the LATEST applied version (re-reading the value there
+        if the read was behind it) — tagging at the read version would let
+        a mutation already applied in (read_version, latest] mint stale
+        hits. Same event-loop tick as the MVCC read, so no mutation can
+        slip between the re-read and the insert."""
+        cur = self.version.get()
+        if read_version != cur:
+            value = self.data.get(key, cur)
+        rc.populate(key, value, cur)
 
     def _on_get_values(self, req, reply):
         self.process.spawn(self._get_values(req, reply), "getValues")
@@ -854,7 +979,17 @@ class StorageServer:
             reply.send_error(e)  # retryable as a unit (future_version etc.)
             return
         data = self.data
+        rc = self._read_cache
+        if rc is not None and req.reads:
+            rc.note_reads(req.reads[0][0], len(req.reads),
+                          self.process.net.loop.now())
         if self.shard_ranges is None:
+            if rc is not None and (rc.entries or rc.hot_ranges):
+                # hot-cache engaged: per-key probes beat the batch walk for
+                # a skewed mix; the cold path below stays byte-identical
+                reply.send(GetValuesReply(
+                    results=self._get_values_cached(rc, req.reads)))
+                return
             if getattr(reply, "wants_bytes", False):
                 encode = getattr(data, "get_batch_encoded", None)
                 if encode is not None:
@@ -869,13 +1004,38 @@ class StorageServer:
         for k, v in req.reads:
             if (not self._owns_key(k)
                     or (self._revoked
-                        and self._revoked_read(k, k + b"\x00", v))):
+                        and self._revoked_read(k, k + b"\x00", v))
+                    or (self._watermarks
+                        and self._below_watermark(k, k + b"\x00", v))):
                 out.append((1, "wrong_shard_server"))
             elif v < oldest:
                 out.append((1, "transaction_too_old"))
             else:
-                out.append((0, data.get(k, v)))
+                if rc is not None:
+                    hit, value = rc.lookup(k, v)
+                    if not hit:
+                        value = data.get(k, v)
+                        if rc.hot_ranges and rc.is_hot(k):
+                            self._cache_populate(rc, k, value, v)
+                else:
+                    value = data.get(k, v)
+                out.append((0, value))
         reply.send(GetValuesReply(results=out))
+
+    def _get_values_cached(self, rc, reads):
+        """Serve-all batch with the hot cache engaged: hits come from one
+        dict probe; misses fall through to the MVCC map and (if hot)
+        populate for the next read."""
+        data = self.data
+        out = []
+        for k, v in reads:
+            hit, value = rc.lookup(k, v)
+            if not hit:
+                value = data.get(k, v)
+                if rc.hot_ranges and rc.is_hot(k):
+                    self._cache_populate(rc, k, value, v)
+            out.append((0, value))
+        return out
 
     # selector resolution (storageserver.actor.cpp findKey) — lives on the
     # versioned map so the C store resolves without per-key Python hops
@@ -893,6 +1053,9 @@ class StorageServer:
                 raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
             if self._revoked and self._revoked_read(
+                    req.begin.key, req.end.key, req.version):
+                raise FDBError("wrong_shard_server")
+            if self._watermarks and self._below_watermark(
                     req.begin.key, req.end.key, req.version):
                 raise FDBError("wrong_shard_server")
             begin = self._resolve_selector(req.begin, req.version)
@@ -928,6 +1091,9 @@ class StorageServer:
                 raise FDBError("wrong_shard_server")
             await self._wait_for_version(req.version)
             if self._revoked and self._revoked_read(
+                    req.key, req.key + b"\x00", req.version):
+                raise FDBError("wrong_shard_server")
+            if self._watermarks and self._below_watermark(
                     req.key, req.key + b"\x00", req.version):
                 raise FDBError("wrong_shard_server")
             current = self.data.get(req.key, self.version.get())

@@ -259,6 +259,207 @@ class ZipfianHotKeyWorkload(Workload):
             f"got={got} want={want}")
 
 
+class ZipfianReadHotspotWorkload(Workload):
+    """Read-heavy zipfian skew against replicated storage: writer actors
+    RMW-increment a narrow hot key set (proven via _commit_resolved markers,
+    so the host ledger counts exactly the landed commits) while reader
+    actors hammer the same keys through the client's replica-balanced read
+    path and the storage-side versioned hot-key cache.
+
+    The readers keep, per key, the highest-version observation seen so far
+    and compare every new (read_version, counter) pair against it:
+
+      v2 == v1  =>  c2 == c1   (two reads at one version must agree — a
+                                divergent replica or a stale cache entry
+                                surfaces here)
+      v2 >  v1  =>  c2 >= c1   (counters only grow; a lower counter at a
+                                higher version is a lost or stale read)
+      v2 <  v1  =>  c2 <= c1   (a read at an OLDER version returning a
+                                newer counter means a replica or cache
+                                served data from the future)
+
+    Because the battery runs clogging + attrition, the observations span
+    shard moves, replica catch-up after recoveries, and cache
+    invalidation/rebuild — exactly the windows where a fencing bug would
+    leak a wrong-version value. After quiesce, the final counters must
+    equal the proven-commit ledger, and (when the cache knob is on) the
+    storage roles must report cache hits: the hot path actually engaged."""
+
+    name = "ZipfianReadHotspot"
+
+    def __init__(self, n_keys: int = 8, n_writers: int = 2,
+                 n_readers: int = 4, theta: float = 1.2,
+                 prefix: bytes = b"zrh/"):
+        self.n = n_keys
+        self.n_writers = n_writers
+        self.n_readers = n_readers
+        self.prefix = prefix
+        w = [1.0 / float(i + 1) ** theta for i in range(n_keys)]
+        tot = sum(w)
+        acc = 0.0
+        self.cdf = []
+        for x in w:
+            acc += x
+            self.cdf.append(acc / tot)
+        self.model = [0] * n_keys
+        self.committed = 0
+        self.reads = 0
+        self.distinct_versions = 0
+        self.cache_hits_seen = 0
+        # per-key highest-version observation: key index -> (version, count)
+        self._best: dict[int, tuple[int, int]] = {}
+
+    def key(self, i: int) -> bytes:
+        return self.prefix + b"%03d" % i
+
+    def _draw_key(self, rng) -> int:
+        r = rng.random()
+        for i, c in enumerate(self.cdf):
+            if r <= c:
+                return i
+        return self.n - 1
+
+    async def setup(self, db):
+        async def fn(tr):
+            for i in range(self.n):
+                tr.set(self.key(i), b"0")
+        await db.transact(fn)
+
+    def _observe(self, i: int, version: int, count: int):
+        """Fold one (read_version, counter) sighting into the per-key
+        monotonicity invariant."""
+        prev = self._best.get(i)
+        if prev is None:
+            self._best[i] = (version, count)
+            return
+        v1, c1 = prev
+        if version == v1:
+            assert count == c1, (
+                f"replica/cache divergence on key {i}: two reads at "
+                f"version {version} returned {c1} and {count}")
+        elif version > v1:
+            assert count >= c1, (
+                f"stale read on key {i}: version {version} > {v1} but "
+                f"counter went {c1} -> {count}")
+            if count > c1:
+                self.distinct_versions += 1
+            self._best[i] = (version, count)
+        else:
+            assert count <= c1, (
+                f"future leak on key {i}: version {version} < {v1} but "
+                f"counter {count} > {c1} seen at the newer version")
+
+    async def _writer(self, db, aid: int, rng):
+        marker = self.prefix + b"__marker%02d__" % aid
+        it = 0
+        while self._time_left():
+            it += 1
+            i = self._draw_key(rng)
+            token = b"w%02d-%06d" % (aid, it)
+
+            async def fn(tr, i=i, token=token):
+                v = await tr.get(self.key(i))
+                tr.set(self.key(i), b"%d" % (int(v or b"0") + 1))
+                tr.set(marker, token)
+                return True
+
+            if await self._commit_resolved(db, fn, marker, token):
+                self.model[i] += 1
+                self.committed += 1
+            await self.cluster.loop.delay(0.05 * (0.5 + rng.random()))
+
+    async def _reader(self, db, rng):
+        retryable = ("transaction_too_old", "future_version", "timed_out",
+                     "transaction_throttled", "proxies_changed",
+                     "cluster_not_fully_recovered", "operation_failed",
+                     "wrong_shard_server", "request_maybe_delivered",
+                     "broken_promise", "all_alternatives_failed")
+        while self._time_left():
+            ks = sorted({self._draw_key(rng)
+                         for _ in range(rng.randint(1, 4))})
+            tr = db.create_transaction()
+            try:
+                vals = await tr.get_many([self.key(i) for i in ks],
+                                         snapshot=True)
+                version = await tr.get_read_version()
+            except FDBError as e:
+                if e.name in retryable:
+                    await self.cluster.loop.delay(
+                        0.1 * (0.5 + rng.random()))
+                    continue
+                raise
+            for i, val in zip(ks, vals):
+                self._observe(i, version, int(val or b"0"))
+            self.reads += len(ks)
+            await self.cluster.loop.delay(0.01 * rng.random())
+
+    def _sample_cache_hits(self) -> int:
+        from foundationdb_tpu.server.storage import StorageServer
+        hits = 0
+        for p in self.cluster.storage_worker_procs:
+            w = getattr(p, "worker", None)
+            if w is None or not p.alive:
+                continue
+            for role in w.roles.values():
+                # rc.hits is the live tally; the CounterCollection copy only
+                # syncs on a STORAGE_METRICS fetch, so read the source
+                if isinstance(role, StorageServer) \
+                        and role._read_cache is not None:
+                    hits += role._read_cache.hits
+        return hits
+
+    async def _cache_monitor(self):
+        """Attrition + the quiesce recovery re-create storage roles (fresh
+        counter collections), so the post-quiesce ledger can legitimately
+        read 0: sample the live roles DURING the run and keep the peak."""
+        while self._time_left():
+            self.cache_hits_seen = max(self.cache_hits_seen,
+                                       self._sample_cache_hits())
+            await self.cluster.loop.delay(0.5)
+
+    async def start(self, db):
+        rngs = [self.rng.fork()
+                for _ in range(self.n_writers + self.n_readers)]
+        tasks = [self.cluster.loop.spawn(self._writer(db, a, rngs[a]),
+                                         f"zrhW{a}")
+                 for a in range(self.n_writers)]
+        tasks += [self.cluster.loop.spawn(
+                      self._reader(db, rngs[self.n_writers + r]),
+                      f"zrhR{r}")
+                  for r in range(self.n_readers)]
+        tasks.append(self.cluster.loop.spawn(self._cache_monitor(),
+                                             "zrhCache"))
+        for t in tasks:
+            await t
+
+    async def check(self, db):
+        from foundationdb_tpu.utils.knobs import KNOBS
+        assert self.committed > 0, "no hot-key increment landed"
+        assert self.reads > 0, "readers made no progress"
+        assert self.distinct_versions > 0, \
+            "readers never saw a counter advance: no read/write overlap"
+
+        async def rd(tr):
+            return await tr.get_range(self.prefix, self.prefix + b"\xff",
+                                      limit=self.n * 4)
+        rows = await db.transact(rd, max_retries=1000)
+        got = {k: v for k, v in rows if b"__marker" not in k}
+        want = {self.key(i): b"%d" % c for i, c in enumerate(self.model)}
+        assert got == want, (
+            f"final counters diverged from the proven-commit ledger after "
+            f"{self.committed} commits / {self.reads} reads: "
+            f"got={got} want={want}")
+
+        # the cache must have ENGAGED when the knob is on (the spec pins
+        # hot-rate/sample knobs so the skew crosses the sketch's bar);
+        # buggify can flip the knob off, in which case hits stay 0 by design
+        if KNOBS.READ_CACHE_ENABLED:
+            hits = max(self.cache_hits_seen, self._sample_cache_hits())
+            assert hits > 0, (
+                f"read cache never hit across {self.reads} skewed reads "
+                f"with READ_CACHE_ENABLED on")
+
+
 class SerializabilityWorkload(Workload):
     """Concurrent register transactions leave a versionstamped history row
     per commit recording (reads seen, writes made); after quiesce the rows —

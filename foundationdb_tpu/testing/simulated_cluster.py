@@ -266,6 +266,15 @@ def _zipfian_hotkey_battery(rng):
             W.AttritionWorkload()]
 
 
+def _zipfian_read_hotspot_battery(rng):
+    # the read scale-out loop through faults: skewed readers asserting
+    # version-consistency across every replica + the versioned hot-key
+    # cache, while clogging forces hedged fail-overs and attrition forces
+    # replica catch-up / cache rebuild after recoveries
+    return [F.ZipfianReadHotspotWorkload(), W.RandomCloggingWorkload(),
+            W.AttritionWorkload()]
+
+
 def _serializability_battery(rng):
     return [F.SerializabilityWorkload(), W.RandomCloggingWorkload(),
             W.AttritionWorkload()]
@@ -322,6 +331,16 @@ SPECS: dict[str, Spec] = {s.name: s for s in [
          # threshold so the zipfian hot range crosses it within the run
          knobs=(("RK_THROTTLE_CONFLICT_RATE", 4.0),
                 ("RK_THROTTLE_RELEASE_TPS", 8.0))),
+    # needs=flat for the same acked-commit-rollback exposure as
+    # zipfian-hotkey; under a "double" draw the readers exercise the
+    # hedged multi-replica path, under "single" the same invariants pin
+    # the cache alone. The knobs force the hot-range sketch to flag the
+    # zipfian prefix within the run so the versioned cache engages.
+    Spec("zipfian-read-hotspot", "fast", _zipfian_read_hotspot_battery,
+         needs="flat",
+         knobs=(("READ_CACHE_HOT_RATE", 1.0),
+                ("READ_CACHE_REFRESH", 0.25),
+                ("READ_CACHE_SAMPLE", 1))),
     Spec("serializability", "fast", _serializability_battery),
     Spec("ryow", "fast", _ryow_battery),
     Spec("conflict-range", "fast", _conflict_range_battery),

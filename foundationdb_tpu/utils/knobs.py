@@ -224,6 +224,24 @@ KNOBS.init("RK_THROTTLE_MAX_BACKOFF", 2.0)  # advised-backoff ceiling
 KNOBS.init("DD_SHARD_SPLIT_CONFLICT_RATE", 50.0)
 KNOBS.init("DD_HOT_SHARD_ROUNDS", 2)  # consecutive hot DD rounds before split
 
+# --- Storage read cache (storageserver read-hot detection re-aimed at the
+# serving path: a bounded version-tagged value cache over ranges the
+# HotRangeSketch flags hot; see docs/architecture.md "Read scale-out") ---
+KNOBS.init("READ_CACHE_ENABLED", True, (False,))
+KNOBS.init("READ_CACHE_MAX_ENTRIES", 4096, (4,))  # bounded: FIFO eviction
+# one read in SAMPLE is folded into the read-hotness sketch (per-batch
+# stride sampling keeps the serve path O(1) per batch, not O(keys))
+KNOBS.init("READ_CACHE_SAMPLE", 16, (1,))
+KNOBS.init("READ_CACHE_TOP_K", 16)  # hot ranges eligible for caching
+# a sampled range is hot when its decayed read rate (scaled back up by the
+# sampling stride) exceeds this, in reads/sec
+KNOBS.init("READ_CACHE_HOT_RATE", 50.0, (1.0,))
+KNOBS.init("READ_CACHE_REFRESH", 0.5)  # hot-set recompute period, seconds
+# storage replicas recruited per shard, every one serving reads (the CC's
+# recruitment fans each shard's tag set across failure domains; clusters
+# constructed with an explicit n_replicas override this default)
+KNOBS.init("READ_REPLICAS", 1)
+
 # --- Data distribution (fdbserver/DataDistributionTracker.actor.cpp) ---
 KNOBS.init("CC_PREEMPT_INTERVAL_SECONDS", 5.0)  # betterMasterExists poll
 KNOBS.init("STORAGE_ENGINE", "memory")  # "memory" | "ssd" | "redwood" (KeyValueStoreType)

@@ -35,7 +35,8 @@ def _check_report(report: dict, n_proxies: int):
     # commit proxy in the core process (the r09 rows said "proxies": 0)
     assert decoded["topology"] == {
         "commit_proxies": max(n_proxies, 1), "grv_proxies": 0,
-        "storage": 1, "client_procs": 1, "merged_core": n_proxies == 0}
+        "storage": 1, "replicas": 1, "client_procs": 1,
+        "merged_core": n_proxies == 0}
     assert decoded["conflict_backend"] == "oracle"
     for kind in _PHASES:
         entry = decoded[kind]
@@ -95,6 +96,40 @@ def test_redwood_read_slice():
     assert decoded["write"]["ops_per_sec"] > 0
     assert decoded["read"]["ops_per_sec"] > 0
     assert "grv_ms_p50" in decoded["read"]
+
+
+def test_replicated_read_slice():
+    """Tier-1 smoke for the read scale-out topology: one shard, two
+    storage replicas, both recruited into the client's location cache as
+    one team. Guards the replicated boot path (per-replica tags fed by the
+    same log), the hedged/EWMA multi-replica read path, and the ledger
+    plumbing — both replicas must actually serve, with zero errors."""
+    report = bench_e2e.run(clients=20, seconds=0.5, backend="oracle",
+                           n_proxies=0, n_storage=1, n_replicas=2,
+                           n_client_procs=1, phases=("read",))
+    decoded = json.loads(json.dumps(report))
+    assert decoded["topology"]["replicas"] == 2
+    entry = decoded["read"]
+    assert entry["ops_per_sec"] > 0
+    assert entry["errors"] == {}
+    served = entry["storage_reads_by_proc"]
+    assert len(served) == 2 and all(v > 0 for v in served.values()), served
+    assert entry["watermark_rejects"] == 0  # static shards: no fencing
+
+
+def test_zipfian_cache_slice():
+    """Tier-1 smoke for the versioned hot-key read cache under the bench
+    driver: the zipfian-read phase must complete cleanly and the storage
+    cache ledger must show hits on the hot prefix (the 1.5s untimed ramp
+    spans the sketch's 0.5s hot-set refresh, so the cache is warm inside
+    the measured window)."""
+    report = bench_e2e.run(clients=20, seconds=1.0, backend="oracle",
+                           n_proxies=0, n_storage=1,
+                           n_client_procs=1, phases=("zipfian-read",))
+    entry = json.loads(json.dumps(report))["zipfian-read"]
+    assert entry["ops_per_sec"] > 0
+    assert entry["errors"] == {}
+    assert entry["read_cache"]["hits"] > 0, entry["read_cache"]
 
 
 def test_native_client_read_slice(monkeypatch):

@@ -17,9 +17,10 @@ from foundationdb_tpu.bindings import bindingtester, fdb_c
 
 @pytest.fixture
 def real_cluster(tmp_path):
-    procs, p_proxies, boundaries, p_storages, _grv = bench_e2e._boot_cluster(
-        str(tmp_path), "oracle", n_proxies=0, n_storage=1)
-    yield p_proxies, boundaries, p_storages
+    procs, _labels, p_proxies, boundaries, teams, _grv = \
+        bench_e2e._boot_cluster(str(tmp_path), "oracle", n_proxies=0,
+                                n_storage=1)
+    yield p_proxies, boundaries, teams
     for p in procs:
         p.terminate()
     for p in procs:
@@ -27,7 +28,7 @@ def real_cluster(tmp_path):
 
 
 def test_capi_surface_and_bindingtester(real_cluster):
-    p_proxies, boundaries, p_storages = real_cluster
+    p_proxies, boundaries, teams = real_cluster
     fdb_c._reset_for_tests()
     # the fdb_c.h lifecycle contract
     assert fdb_c.fdb_setup_network() != 0, "setup before version must fail"
@@ -41,7 +42,7 @@ def test_capi_surface_and_bindingtester(real_cluster):
     try:
         cluster = {"proxies": p_proxies,
                    "boundaries": boundaries,
-                   "storages": [[s] for s in p_storages]}
+                   "storages": [list(t) for t in teams]}
         err, db = fdb_c.fdb_create_database(cluster)
         assert err == 0 and db is not None
 
@@ -76,7 +77,7 @@ def test_capi_surface_and_bindingtester(real_cluster):
         client.start()
         ndb = Database(client.process, proxies=list(p_proxies),
                        locations=LocationCache(
-                           list(boundaries), [[s] for s in p_storages]))
+                           list(boundaries), [list(t) for t in teams]))
         checked = bindingtester.compare_runs(977, 2000, db, loop, ndb)
         checked += bindingtester.compare_runs(31337, 1000, db, loop, ndb,
                                               prefix_c=b"bt2_c/",

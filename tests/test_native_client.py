@@ -517,7 +517,9 @@ def test_read_group_settles_same_tick_with_span():
     db = object.__new__(Database)
     db.loop = types.SimpleNamespace(now=lambda: 1.0)
     db.process = types.SimpleNamespace(net=_Net())
-    db._replica_stats = types.SimpleNamespace(record=lambda addr, dt: None)
+    db._replica_stats = types.SimpleNamespace(
+        record=lambda addr, dt: None,
+        begin=lambda addr: None, end=lambda addr: None)
     db.coordinators = None
     db._team_order = lambda team: team
     db._next_span_id = lambda kind: "r-tick"
@@ -621,7 +623,8 @@ def test_proto005_parses_client_request_pins():
     assert schemas["GetValuesRequest"].fields == ["reads"]
     assert schemas["GetKeyValuesRequest"].fields == [
         "begin", "end", "version", "limit", "limit_bytes", "reverse"]
-    assert schemas["GetReadVersionRequest"].fields == ["priority", "debug_id"]
+    assert schemas["GetReadVersionRequest"].fields == [
+        "priority", "debug_id", "count"]
 
 
 def test_proto005_request_parity_holds_on_the_real_tree():

@@ -1,0 +1,283 @@
+"""Read scale-out unit tests: the versioned hot-key cache's
+invalidation-at-version contract, the storage server's fetched-version
+watermark fencing, and hedged reads settling on the first replica to answer.
+
+Reference: fdbserver/StorageCache.actor.cpp (version-tagged serving),
+storageserver.actor.cpp fetchKeys (local history begins at the splice's
+snapshot version — serving below it would fabricate an empty past), and
+fdbrpc/LoadBalance.actor.h:159 (backup requests: first response wins,
+the loser is ignored, correctness never depends on which one answered).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.core.eventloop import EventLoop
+from foundationdb_tpu.core.sim import Endpoint, SimNetwork
+from foundationdb_tpu.server.interfaces import (
+    AddShardRequest, GetKeyValuesReply, GetValueRequest, TLogPeekReply,
+    Token)
+from foundationdb_tpu.server.readcache import VersionedReadCache
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.utils.errors import FDBError
+from foundationdb_tpu.utils.knobs import KNOBS
+from foundationdb_tpu.utils.rng import DeterministicRandom
+from foundationdb_tpu.utils.types import Mutation, MutationType
+
+
+@pytest.fixture(autouse=True)
+def _reset_knobs():
+    yield
+    KNOBS.reset()
+
+
+# ---------------------------------------------------------------------------
+# VersionedReadCache: the version-tag contract, pure
+# ---------------------------------------------------------------------------
+
+def _hot_cache(**kw) -> VersionedReadCache:
+    """A cache whose hot set is forced by hand (no sketch warm-up)."""
+    kw.setdefault("max_entries", 8)
+    kw.setdefault("sample", 1)
+    kw.setdefault("hot_rate", 1.0)
+    rc = VersionedReadCache(**kw)
+    rc.hot_ranges = [(b"hot/", b"hot0")]
+    return rc
+
+
+def _set(k, v):
+    return Mutation(MutationType.SET_VALUE, k, v)
+
+
+def _clear_range(b, e):
+    return Mutation(MutationType.CLEAR_RANGE, b, e)
+
+
+class TestVersionedReadCache:
+    def test_hit_only_at_or_above_valid_from(self):
+        """The tag proves exactness for v >= valid_from and NOTHING below:
+        a read at an older version must fall through to MVCC (the cached
+        value may postdate it)."""
+        rc = _hot_cache()
+        rc.populate(b"hot/a", b"v7", latest_version=700)
+        assert rc.lookup(b"hot/a", 700) == (True, b"v7")
+        assert rc.lookup(b"hot/a", 900) == (True, b"v7")
+        hit, _ = rc.lookup(b"hot/a", 699)
+        assert not hit, "served a value tagged ABOVE the read version"
+
+    def test_point_write_invalidates_at_its_version(self):
+        """A committed mutation drops the entry in the same tick it is
+        applied, so no read at any version >= the write can hit the stale
+        value; a re-populate then tags at the post-write version."""
+        rc = _hot_cache()
+        rc.populate(b"hot/a", b"old", latest_version=700)
+        rc.invalidate([_set(b"hot/a", b"new")])
+        assert rc.invalidations == 1
+        assert rc.lookup(b"hot/a", 800) == (False, None)
+        rc.populate(b"hot/a", b"new", latest_version=800)
+        assert rc.lookup(b"hot/a", 800) == (True, b"new")
+        hit, _ = rc.lookup(b"hot/a", 750)
+        assert not hit, "pre-write version must not see the post-write value"
+
+    def test_clear_range_invalidates_only_touched_keys(self):
+        rc = _hot_cache()
+        rc.populate(b"hot/a", b"1", latest_version=10)
+        rc.populate(b"hot/b", b"2", latest_version=10)
+        rc.populate(b"hot/z", b"3", latest_version=10)
+        rc.invalidate([_clear_range(b"hot/a", b"hot/c")])
+        assert rc.invalidations == 2
+        assert rc.lookup(b"hot/a", 20) == (False, None)
+        assert rc.lookup(b"hot/b", 20) == (False, None)
+        assert rc.lookup(b"hot/z", 20) == (True, b"3")
+
+    def test_untouched_keys_survive_other_writes(self):
+        rc = _hot_cache()
+        rc.populate(b"hot/a", b"1", latest_version=10)
+        rc.invalidate([_set(b"hot/other", b"x")])
+        assert rc.invalidations == 0
+        assert rc.lookup(b"hot/a", 50) == (True, b"1")
+
+    def test_clear_drops_everything(self):
+        """Rollback / fetchKeys splice rewrite history out from under the
+        tags: the whole table goes."""
+        rc = _hot_cache()
+        rc.populate(b"hot/a", b"1", latest_version=10)
+        rc.populate(b"hot/b", b"2", latest_version=10)
+        rc.clear()
+        assert rc.invalidations == 2
+        assert rc.entries == {}
+
+    def test_populate_refuses_cold_keys_and_bounds_entries(self):
+        rc = _hot_cache(max_entries=2)
+        rc.populate(b"cold/x", b"v", latest_version=1)
+        assert rc.entries == {}, "cold key must not be cached"
+        rc.populate(b"hot/a", b"1", latest_version=1)
+        rc.populate(b"hot/b", b"2", latest_version=1)
+        rc.populate(b"hot/c", b"3", latest_version=1)  # evicts FIFO head
+        assert len(rc.entries) == 2 and rc.evictions == 1
+        assert rc.lookup(b"hot/a", 5) == (False, None)
+        assert rc.lookup(b"hot/c", 5) == (True, b"3")
+
+    def test_none_value_is_cacheable(self):
+        """Absence is a value too: a hot key that does not exist hits as
+        None instead of re-walking the MVCC map every probe."""
+        rc = _hot_cache()
+        rc.populate(b"hot/missing", None, latest_version=30)
+        assert rc.lookup(b"hot/missing", 40) == (True, None)
+
+
+# ---------------------------------------------------------------------------
+# Watermark fencing on a live storage server (scripted TLog harness)
+# ---------------------------------------------------------------------------
+
+class _ScriptedTLog:
+    """A fake TLog process serving a fixed message list (the
+    test_storage_safety harness, trimmed to what fencing needs)."""
+
+    def __init__(self, process, messages, end, kc):
+        self.process = process
+        self.messages = messages
+        self.end = end
+        self.kc = kc
+        process.register(Token.TLOG_PEEK, self._on_peek)
+        process.register(Token.TLOG_POP, lambda req, reply: reply.send(None))
+
+    def _on_peek(self, req, reply):
+        msgs = [(v, list(muts)) for v, muts in self.messages
+                if v >= req.begin]
+        reply.send(TLogPeekReply(messages=msgs, end=self.end, popped=0,
+                                 known_committed_version=self.kc))
+
+
+def _fencing_harness():
+    """One storage server on [a, b) fed by a scripted log, plus a source
+    process ready to serve a fetchKeys snapshot of [m, n)."""
+    KNOBS.set("MAX_READ_TRANSACTION_LIFE_VERSIONS", 10)
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(11))
+    tlog_proc = net.new_process("tlog:0")
+    msgs = [(v, [_set(b"a%03d" % v, b"v")]) for v in range(1, 51)]
+    tlog = _ScriptedTLog(tlog_proc, msgs, end=51, kc=50)
+
+    src_proc = net.new_process("src:0")
+
+    def on_get_kv(req, reply):
+        reply.send(GetKeyValuesReply(data=[(b"m00", b"s")], more=False,
+                                     version=req.version))
+    src_proc.register(Token.STORAGE_GET_KEY_VALUES, on_get_kv)
+
+    ss_proc = net.new_process("ss:0")
+    ss = StorageServer(ss_proc, tag=0, tlog_addrs=["tlog:0"],
+                       shard_ranges=[(b"a", b"b")])
+    client = net.new_process("client:0")
+    return loop, net, tlog, ss, client
+
+
+def test_fetched_watermark_fences_reads_below_snapshot():
+    """After a fetchKeys splice at snapshot version c0, the spliced range's
+    local history STARTS at c0: a read below it must get wrong_shard_server
+    (re-resolve onto a replica that lived through those versions) and bump
+    the WatermarkRejects ledger, while reads at/above c0 serve normally."""
+    loop, net, tlog, ss, client = _fencing_harness()
+
+    async def t():
+        await loop.delay(2.0)
+        c0 = await net.request(
+            client, Endpoint("ss:0", Token.STORAGE_ADD_SHARD),
+            AddShardRequest(begin=b"m", end=b"n", source="src:0",
+                            fence_version=40))
+        assert c0 == 50, c0
+        assert ss._watermarks == [(b"m", b"n", 50)]
+
+        async def read(key, version):
+            return await net.request(
+                client, Endpoint("ss:0", Token.STORAGE_GET_VALUE),
+                GetValueRequest(key=key, version=version))
+
+        # at/above the snapshot: the spliced row serves
+        assert (await read(b"m00", 50)).value == b"s"
+        # below it: fenced, and the ledger counts the reject
+        before = ss.counters.as_dict()["WatermarkRejects"]
+        with pytest.raises(FDBError) as ei:
+            await read(b"m00", 49)
+        assert ei.value.name == "wrong_shard_server"
+        assert ss.counters.as_dict()["WatermarkRejects"] == before + 1
+        # the ORIGINAL shard has full local history: no fence applies to a
+        # below-c0 read there (45 is inside the MVCC window, floor is 40)
+        assert (await read(b"a045", 45)).value == b"v"
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+def test_watermark_pruned_once_mvcc_floor_passes():
+    """A watermark at/below the MVCC floor can never fire again (those
+    versions already throw transaction_too_old): durability advancing past
+    it must prune the fence so the serve path stops paying for it."""
+    loop, net, tlog, ss, client = _fencing_harness()
+
+    async def t():
+        await loop.delay(2.0)
+        c0 = await net.request(
+            client, Endpoint("ss:0", Token.STORAGE_ADD_SHARD),
+            AddShardRequest(begin=b"m", end=b"n", source="src:0",
+                            fence_version=40))
+        assert c0 == 50 and ss._watermarks
+        # extend the log well past c0 + the read-life window and let
+        # durability advance: the floor passes 50, the fence goes
+        tlog.messages.extend(
+            (v, [_set(b"a%03d" % v, b"v")]) for v in range(51, 151))
+        tlog.end = 151
+        tlog.kc = 150
+        await loop.delay(5.0)
+        assert ss.data.oldest_version >= 50
+        assert ss._watermarks == [], ss._watermarks
+        # reads below the old fence now fail as too-old, not wrong-shard
+        with pytest.raises(FDBError) as ei:
+            await net.request(
+                client, Endpoint("ss:0", Token.STORAGE_GET_VALUE),
+                GetValueRequest(key=b"m00", version=49))
+        assert ei.value.name == "transaction_too_old"
+
+    loop.run_future(loop.spawn(t()), max_time=600.0)
+
+
+# ---------------------------------------------------------------------------
+# Hedged reads: first replica to settle wins, ledger records it
+# ---------------------------------------------------------------------------
+
+def test_hedge_settles_first_wins_and_ledger_records_it():
+    """With one replica of a 2-replica team clogged, the first read sent
+    there must be rescued by a backup request to the healthy replica: the
+    hedge's reply settles the read (correct value, no stall) and the
+    client's lb ledger records both the hedge and the win."""
+    KNOBS.set("CONFLICT_BACKEND", "oracle")
+    from foundationdb_tpu.server.cluster import RecoverableCluster
+    c = RecoverableCluster(seed=31, n_workers=4, n_proxies=1, n_tlogs=1,
+                           n_storage=1, n_replicas=2, n_storage_workers=2)
+    db = c.database()
+
+    async def t():
+        await db.refresh()
+
+        async def setup(tr):
+            for i in range(8):
+                tr.set(b"hw%02d" % i, b"v%02d" % i)
+        await db.transact(setup)
+
+        team, _end = db.locations.locate(b"hw00")
+        assert len(team) == 2, team
+        # clog the link to one replica for the whole test: any read routed
+        # there first can only finish through its backup request, so every
+        # completed read that touched team[0] is a settled-by-hedge proof
+        c.net.clog_pair(db.process.address, team[0], 600.0)
+
+        for i in range(12):
+            tr = db.create_transaction()
+            v = await tr.get(b"hw%02d" % (i % 8))
+            assert v == b"v%02d" % (i % 8)
+
+    c.run(c.loop.spawn(t()), max_time=30_000.0)
+    snap = db.lb_snapshot()
+    assert snap["hedges"] >= 1, snap
+    assert snap["hedge_wins"] >= 1, snap

@@ -35,6 +35,10 @@ FAST_SWEEP_SEEDS = [1, 2, 3, 4, 5, 7, 8, 10, 13, 15, 19, 25, 38, 46]
 PINNED_FAST = [
     ("cycle", 15),            # single/memory/sharded
     ("zipfian-hotkey", 2),    # single/memory/oracle (needs flat)
+    ("zipfian-read-hotspot", 25),  # double/memory/oracle (needs flat):
+    # the 2-replica draw, so the hedged multi-replica client path serves
+    # the skewed readers through clogging + attrition
+
     ("conflict-range", 2),    # single/memory/oracle
     ("fuzz-api", 19),         # single/redwood/oracle
     ("serializability", 23),  # single/ssd/oracle
